@@ -1,0 +1,133 @@
+// Native text/CSV parser for distributed_active_learning_tpu.
+//
+// The reference's IO layer is HDFS text reads parsed by JVM executors
+// (sc.textFile + per-line Python lambdas, e.g. classes/dataset.py:253-259 and
+// mllib/credit_card_fraud.py:22-24). This is the TPU build's native
+// equivalent: a single-pass C++ tokenizer exposed via a C ABI (consumed with
+// ctypes from data/_native.py), turning large on-disk pools into dense float32
+// row-major matrices far faster than Python line loops.
+//
+// Modes:
+//   is_csv == 0 : whitespace-separated, all non-empty lines are data rows.
+//   is_csv == 1 : comma-separated, first line is a header and is skipped,
+//                 double-quotes around fields are stripped (the fraud CSV wraps
+//                 its label in quotes).
+//
+// Ragged rows are an error (rc != 0) so the Python side falls back to numpy,
+// which raises — native and fallback agree on rejecting malformed input.
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+bool read_file(const char* path, std::string& out) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return false;
+  std::fseek(f, 0, SEEK_END);
+  long size = std::ftell(f);
+  if (size < 0) {
+    std::fclose(f);
+    return false;
+  }
+  std::fseek(f, 0, SEEK_SET);
+  out.resize(static_cast<size_t>(size));
+  size_t got = size ? std::fread(&out[0], 1, static_cast<size_t>(size), f) : 0;
+  std::fclose(f);
+  return got == static_cast<size_t>(size);
+}
+
+inline bool is_line_break(char c) { return c == '\n' || c == '\r'; }
+
+inline bool is_blank_line(const char* p, const char* end) {
+  for (; p != end && !is_line_break(*p); ++p) {
+    if (*p != ' ' && *p != '\t' && *p != ',') return false;
+  }
+  return true;
+}
+
+// Parse one line's fields into out (appending). Returns field count, or -1 on
+// a token that fails to parse as a float. With out == nullptr only counts
+// tokens (no strtof) — the cheap dimension-counting pass.
+long parse_line(const char* p, const char* end, bool csv, std::vector<float>* out) {
+  long count = 0;
+  while (p < end && !is_line_break(*p)) {
+    // skip leading separators / quotes
+    while (p < end && !is_line_break(*p) &&
+           (*p == ' ' || *p == '\t' || *p == '"' || (csv && *p == ','))) {
+      ++p;
+    }
+    if (p >= end || is_line_break(*p)) break;
+    if (out) {
+      char* next = nullptr;
+      float v = std::strtof(p, &next);
+      if (next == p) return -1;
+      out->push_back(v);
+      p = next;
+    } else {
+      while (p < end && !is_line_break(*p) && *p != ' ' && *p != '\t' &&
+             *p != '"' && !(csv && *p == ',')) {
+        ++p;
+      }
+    }
+    ++count;
+  }
+  return count;
+}
+
+// Shared scan: counts rows/cols, optionally filling `values`.
+int scan(const char* path, int is_csv, long* n_rows, long* n_cols,
+         std::vector<float>* values) {
+  std::string buf;
+  if (!read_file(path, buf)) return 1;
+  const char* p = buf.data();
+  const char* end = p + buf.size();
+  bool csv = is_csv != 0;
+  long rows = 0;
+  long cols = -1;
+  bool header_skipped = !csv;
+  while (p < end) {
+    const char* line_end = p;
+    while (line_end < end && !is_line_break(*line_end)) ++line_end;
+    if (!is_blank_line(p, line_end)) {
+      if (!header_skipped) {
+        header_skipped = true;  // CSV: first non-blank line is the header
+      } else {
+        long c = parse_line(p, line_end, csv, values);
+        if (c <= 0) return 2;            // unparseable token
+        if (cols == -1) cols = c;
+        else if (c != cols) return 3;    // ragged row
+        ++rows;
+      }
+    }
+    p = line_end;
+    while (p < end && is_line_break(*p)) ++p;
+  }
+  if (rows == 0 || cols <= 0) return 4;
+  *n_rows = rows;
+  *n_cols = cols;
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+int dal_count_dims(const char* path, int is_csv, long* n_rows, long* n_cols) {
+  return scan(path, is_csv, n_rows, n_cols, nullptr);
+}
+
+int dal_parse_matrix(const char* path, int is_csv, float* out, long capacity,
+                     long* n_rows, long* n_cols) {
+  std::vector<float> values;
+  int rc = scan(path, is_csv, n_rows, n_cols, &values);
+  if (rc != 0) return rc;
+  if (static_cast<long>(values.size()) > capacity) return 5;
+  std::memcpy(out, values.data(), values.size() * sizeof(float));
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,26 @@
+"""TPU-native distributed active-learning framework.
+
+A from-scratch JAX/XLA re-design of the capabilities of the Spark-based reference
+``dv66/Distributed-Active-Learning`` (see SURVEY.md): pool-based active learning
+with random / uncertainty / entropy / density-weighted / LAL query strategies over
+random-forest (and neural) base learners.
+
+Design stance (SURVEY.md §7): the unlabeled pool is a fixed dense array resident in
+device memory; labeled/unlabeled sets are boolean masks (never dynamically-shaped
+subsets); one AL round is a single jitted function; the forest is a packed tensor
+ensemble traversed by gather and vmapped over (trees x points); similarity is a
+blocked MXU matmul; ``lax.top_k`` replaces distributed sort+take; ``shard_map`` +
+collectives over a ``jax.sharding.Mesh`` replace Spark RDD shuffles.
+
+Package layout:
+  data/       dataset loaders, scaling, synthetic generators  (ref L0/L3)
+  models/     forest + neural base learners                    (ref L2)
+  ops/        jitted kernels: tree traversal, similarity, scoring, top-k
+  parallel/   mesh construction, shardings, collectives        (ref L1)
+  strategies/ query-strategy registry                          (ref L4)
+  runtime/    AL state, driver loop, checkpointing, tracing    (ref L5)
+"""
+
+__version__ = "0.1.0"
+
+from distributed_active_learning_tpu import config  # noqa: F401

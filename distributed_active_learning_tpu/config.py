@@ -1,0 +1,123 @@
+"""Experiment configuration dataclasses.
+
+The reference has no config system at all — every tunable is a hardcoded module
+constant (window_size / n_samples / n_estimators / beta at
+``final_thesis/density_weighting.py:29-33``, per-file window sizes at
+``uncertainty_sampling.py:46`` and ``random_sampling.py:47``, dataset switching by
+editing commented lines at ``classes/dataset.py:31-40``). This module replaces that
+with typed, frozen dataclasses so experiments are reproducible and serializable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Mapping, Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class ForestConfig:
+    """Random-forest base-learner configuration.
+
+    Mirrors the knobs the reference passes to ``RandomForest.trainClassifier``
+    (``final_thesis/uncertainty_sampling.py:71-76``: numTrees, maxDepth=4,
+    maxBins=32, 'gini') but with a fixed node budget so the packed on-device
+    representation has static shapes across AL rounds (no recompiles).
+    """
+
+    n_trees: int = 10
+    max_depth: int = 4
+    max_bins: int = 32
+    criterion: str = "gini"
+    # Static node budget per tree for the packed representation. A binary tree of
+    # depth D has at most 2^(D+1) - 1 nodes; loaders assert fit.
+    node_budget: Optional[int] = None
+    seed: int = 0
+
+    @property
+    def resolved_node_budget(self) -> int:
+        if self.node_budget is not None:
+            return self.node_budget
+        return 2 ** (self.max_depth + 1) - 1
+
+
+@dataclasses.dataclass(frozen=True)
+class StrategyConfig:
+    """Query-strategy configuration.
+
+    ``name`` selects from the strategy registry (strategies/__init__.py).
+    ``window_size`` is the batch ("window") of points queried per round —
+    the reference uses 10/50/100 (``uncertainty_sampling.py:46``) and 1 for the
+    OOP single-point mode. ``beta`` weights the density term
+    (``density_weighting.py:33``).
+    """
+
+    name: str = "uncertainty"
+    window_size: int = 10
+    beta: float = 1.0
+    # Extra per-strategy options (e.g. LAL regressor config, MC-dropout samples).
+    options: Mapping[str, Any] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass(frozen=True)
+class DataConfig:
+    """Dataset selection + preprocessing.
+
+    ``name`` selects from the dataset registry; ``path`` points at on-disk data
+    for file-backed datasets (striatum/credit-card formats). ``standardize``
+    replicates the reference's StandardScaler(withMean, withStd) step
+    (``classes/dataset.py:163-165``).
+    """
+
+    name: str = "checkerboard2x2"
+    path: Optional[str] = None
+    standardize: bool = True
+    # None = per-dataset default. True reproduces the reference's quirk of
+    # fitting a *separate* scaler on the test set (flagged as an inconsistency
+    # at ``classes/dataset.py:268-271``); False uses the train-fitted scaler.
+    scale_test_independently: Optional[bool] = None
+    n_samples: Optional[int] = None  # subsample pool (density_weighting.py:30)
+    seed: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Device-mesh layout for the sharded AL round.
+
+    ``data`` shards the pool rows (replaces Spark RDD partitioning of the pool),
+    ``model`` shards the tree/ensemble axis (replaces the reference's sequential
+    per-tree Spark jobs, ``classes/active_learner.py:169-184``).
+    """
+
+    data: int = 1
+    model: int = 1
+
+    @property
+    def shape(self) -> Tuple[Tuple[str, int], ...]:
+        return (("data", self.data), ("model", self.model))
+
+
+@dataclasses.dataclass(frozen=True)
+class ExperimentConfig:
+    """Top-level AL experiment: dataset + model + strategy + loop controls."""
+
+    data: DataConfig = dataclasses.field(default_factory=DataConfig)
+    forest: ForestConfig = dataclasses.field(default_factory=ForestConfig)
+    strategy: StrategyConfig = dataclasses.field(default_factory=StrategyConfig)
+    mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+    # Number of initially-labeled points (Dataset.setStartState nStart,
+    # classes/dataset.py:56). The reference seeds 1 positive + 1 negative + extras.
+    n_start: int = 10
+    # Stop when this many points are labeled, or pool exhausted (None = exhaust).
+    label_budget: Optional[int] = None
+    max_rounds: Optional[int] = None
+    seed: int = 0
+    # Observability
+    log_every: int = 1
+    checkpoint_dir: Optional[str] = None
+    checkpoint_every: int = 0  # 0 = disabled
+    results_path: Optional[str] = None
+
+
+def asdict(cfg: Any) -> dict:
+    """Serialize any config dataclass to a plain dict (for checkpoint metadata)."""
+    return dataclasses.asdict(cfg)

@@ -1,0 +1,52 @@
+"""Data layer: file-format parsers, standardization, synthetic generators, registry.
+
+Replaces the reference's L0 (HDFS text files) + L3 (Dataset classes / index
+bookkeeping) layers (SURVEY.md §1) with host-side array loading feeding dense
+device-resident pools.
+"""
+
+from distributed_active_learning_tpu.data.formats import (
+    load_labeled_text,
+    load_credit_card_csv,
+    load_triplet_text,
+    write_triplet_text,
+)
+from distributed_active_learning_tpu.data.scaler import (
+    StandardScalerState,
+    fit_standard_scaler,
+    transform,
+    fit_transform,
+)
+from distributed_active_learning_tpu.data.synthetic import (
+    make_xor,
+    make_checkerboard,
+    make_rotated_checkerboard,
+    make_gaussian_unbalanced,
+    make_random_matrix,
+)
+from distributed_active_learning_tpu.data.datasets import (
+    DataBundle,
+    get_dataset,
+    register_dataset,
+    available_datasets,
+)
+
+__all__ = [
+    "load_labeled_text",
+    "load_credit_card_csv",
+    "load_triplet_text",
+    "write_triplet_text",
+    "StandardScalerState",
+    "fit_standard_scaler",
+    "transform",
+    "fit_transform",
+    "make_xor",
+    "make_checkerboard",
+    "make_rotated_checkerboard",
+    "make_gaussian_unbalanced",
+    "make_random_matrix",
+    "DataBundle",
+    "get_dataset",
+    "register_dataset",
+    "available_datasets",
+]

@@ -1,0 +1,109 @@
+"""ctypes binding for the native C++ data loader (``cpp/loader.cpp``).
+
+The reference's IO substrate is HDFS text reads executed by JVM workers
+(``sc.textFile``, ``classes/dataset.py:254``); here the equivalent native layer
+is a small C++ parser compiled to a shared library and reached via ctypes. All
+entry points return ``None`` when the library is unavailable so callers fall
+back to the pure-numpy path (which doubles as the correctness oracle in tests).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+_LIB = None
+_LIB_TRIED = False
+
+
+def _find_lib() -> Optional[ctypes.CDLL]:
+    global _LIB, _LIB_TRIED
+    if _LIB_TRIED:
+        return _LIB
+    _LIB_TRIED = True
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    candidates = [
+        os.path.join(here, "..", "cpp", "build", "libdal_loader.so"),
+        os.path.join(here, "cpp", "libdal_loader.so"),
+    ]
+    env = os.environ.get("DAL_TPU_LOADER_LIB")
+    if env:
+        candidates.insert(0, env)
+    for cand in candidates:
+        cand = os.path.abspath(cand)
+        if os.path.exists(cand):
+            try:
+                lib = ctypes.CDLL(cand)
+            except OSError:
+                continue
+            # int dal_parse_matrix(const char* path, int is_csv, float* out,
+            #                      long capacity, long* n_rows, long* n_cols)
+            lib.dal_parse_matrix.restype = ctypes.c_int
+            lib.dal_parse_matrix.argtypes = [
+                ctypes.c_char_p,
+                ctypes.c_int,
+                ctypes.POINTER(ctypes.c_float),
+                ctypes.c_long,
+                ctypes.POINTER(ctypes.c_long),
+                ctypes.POINTER(ctypes.c_long),
+            ]
+            lib.dal_count_dims.restype = ctypes.c_int
+            lib.dal_count_dims.argtypes = [
+                ctypes.c_char_p,
+                ctypes.c_int,
+                ctypes.POINTER(ctypes.c_long),
+                ctypes.POINTER(ctypes.c_long),
+            ]
+            _LIB = lib
+            return _LIB
+    return None
+
+
+def _parse(path: str, is_csv: bool) -> Optional[np.ndarray]:
+    lib = _find_lib()
+    if lib is None or not os.path.exists(path):
+        return None
+    n_rows = ctypes.c_long(0)
+    n_cols = ctypes.c_long(0)
+    rc = lib.dal_count_dims(path.encode(), int(is_csv), ctypes.byref(n_rows), ctypes.byref(n_cols))
+    if rc != 0 or n_rows.value <= 0 or n_cols.value <= 0:
+        return None
+    expect = (n_rows.value, n_cols.value)
+    out = np.empty(expect, dtype=np.float32)
+    rc = lib.dal_parse_matrix(
+        path.encode(),
+        int(is_csv),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        out.size,
+        ctypes.byref(n_rows),
+        ctypes.byref(n_cols),
+    )
+    if rc != 0 or (n_rows.value, n_cols.value) != expect:
+        # dims changed between the count and parse passes (file mutated
+        # mid-read): the packed buffer would not match the array strides.
+        return None
+    return out
+
+
+def try_load_matrix(path: str, sep: Optional[str]) -> Optional[np.ndarray]:
+    """Native parse of a whitespace-separated dense matrix; None if unavailable.
+
+    Only ``sep=None`` (any-whitespace) is handled natively: an explicit
+    ``sep=" "`` means numpy's strict single-space semantics, which the C
+    tokenizer does not reproduce — let the fallback handle it so accepted
+    inputs don't depend on whether the .so is built.
+    """
+    if sep is not None:
+        return None
+    return _parse(path, is_csv=False)
+
+
+def try_load_csv_label_last(path: str) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Native parse of a header+quoted-label CSV; None if unavailable."""
+    mat = _parse(path, is_csv=True)
+    if mat is None:
+        return None
+    return np.ascontiguousarray(mat[:, :-1]), mat[:, -1].astype(np.int32)

@@ -1,0 +1,157 @@
+"""Dataset registry producing device-ready train/test bundles.
+
+Replaces the reference's ``Dataset`` class hierarchy
+(``classes/dataset.py:48-273``: DatasetCheckerboard2x2 / 4x4 / Rotated /
+StriatumMini) and the inlined loading in ``final_thesis/*.py:37-42``. Each entry
+returns a :class:`DataBundle` of dense float32/int32 arrays, already
+standardized when the config asks for it (the reference scales with MLlib
+StandardScaler at ``dataset.py:163-165``; note it fits a *separate* scaler on
+the test set — ``dataset.py:268-271`` flags this as a known inconsistency; we
+default to the statistically-correct train-fitted scaler and expose
+``scale_test_independently`` to reproduce the reference exactly).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, NamedTuple, Optional
+
+import jax
+import numpy as np
+
+from distributed_active_learning_tpu.config import DataConfig
+from distributed_active_learning_tpu.data import formats, scaler, synthetic
+
+
+class DataBundle(NamedTuple):
+    """Dense train/test arrays for one AL experiment."""
+
+    train_x: np.ndarray  # [n, d] float32
+    train_y: np.ndarray  # [n] int32 — the oracle's labels, revealed via the mask
+    test_x: np.ndarray   # [m, d] float32
+    test_y: np.ndarray   # [m] int32
+    name: str = ""
+
+    @property
+    def n_pool(self) -> int:
+        return self.train_x.shape[0]
+
+    @property
+    def n_features(self) -> int:
+        return self.train_x.shape[1]
+
+
+_REGISTRY: Dict[str, Callable[[DataConfig], DataBundle]] = {}
+
+
+def register_dataset(name: str):
+    def deco(fn: Callable[[DataConfig], DataBundle]):
+        _REGISTRY[name] = fn
+        return fn
+    return deco
+
+
+def available_datasets():
+    return sorted(_REGISTRY)
+
+
+def get_dataset(cfg: DataConfig) -> DataBundle:
+    if cfg.name not in _REGISTRY:
+        raise KeyError(f"unknown dataset {cfg.name!r}; available: {available_datasets()}")
+    bundle = _REGISTRY[cfg.name](cfg)
+    if cfg.n_samples is not None and cfg.n_samples < bundle.n_pool:
+        # Pool subsampling, as density_weighting.py:30 (n_samples=5000) does.
+        rng = np.random.default_rng(cfg.seed)
+        idx = rng.permutation(bundle.n_pool)[: cfg.n_samples]
+        bundle = bundle._replace(
+            train_x=bundle.train_x[idx], train_y=bundle.train_y[idx]
+        )
+    return bundle
+
+
+def _standardize(bundle: DataBundle, cfg: DataConfig, independent_test: bool = False) -> DataBundle:
+    if not cfg.standardize:
+        return bundle
+    if cfg.scale_test_independently is not None:
+        independent_test = cfg.scale_test_independently
+    st = scaler.fit_standard_scaler(bundle.train_x)
+    train_x = np.asarray(scaler.transform(st, bundle.train_x), dtype=np.float32)
+    if independent_test:
+        # Reference behavior: separate scaler fit on test (dataset.py:268-271).
+        test_x = np.asarray(scaler.fit_transform(bundle.test_x), dtype=np.float32)
+    else:
+        test_x = np.asarray(scaler.transform(st, bundle.test_x), dtype=np.float32)
+    return bundle._replace(train_x=train_x, test_x=test_x)
+
+
+def _synth(cfg: DataConfig, gen, n_train: int, n_test: int, name: str, **kw) -> DataBundle:
+    k_tr, k_te = jax.random.split(jax.random.key(cfg.seed))
+    train_x, train_y = gen(k_tr, n_train, **kw)
+    test_x, test_y = gen(k_te, n_test, **kw)
+    bundle = DataBundle(
+        train_x=np.asarray(train_x), train_y=np.asarray(train_y),
+        test_x=np.asarray(test_x), test_y=np.asarray(test_y), name=name,
+    )
+    return _standardize(bundle, cfg)
+
+
+@register_dataset("checkerboard2x2")
+def _checkerboard2x2(cfg: DataConfig) -> DataBundle:
+    return _synth(cfg, synthetic.make_checkerboard, 1000, 1000, "checkerboard2x2", grid=2)
+
+
+@register_dataset("checkerboard4x4")
+def _checkerboard4x4(cfg: DataConfig) -> DataBundle:
+    return _synth(cfg, synthetic.make_checkerboard, 1000, 1000, "checkerboard4x4", grid=4)
+
+
+@register_dataset("rotated_checkerboard2x2")
+def _rotated(cfg: DataConfig) -> DataBundle:
+    return _synth(cfg, synthetic.make_rotated_checkerboard, 1000, 1000, "rotated_checkerboard2x2")
+
+
+@register_dataset("xor")
+def _xor(cfg: DataConfig) -> DataBundle:
+    return _synth(cfg, synthetic.make_xor, 10000, 2000, "xor", d=10)
+
+
+@register_dataset("striatum")
+def _striatum(cfg: DataConfig) -> DataBundle:
+    """Label-last whitespace text files, -1 remapped to 0 (dataset.py:245-273).
+
+    ``cfg.path`` must point at a directory holding ``striatum_train_mini.txt``
+    and ``striatum_test_mini.txt`` (the reference reads them from HDFS at
+    ``dataset.py:253`` — there is no HDFS here, plain files instead).
+    """
+    import os
+    if cfg.path is None:
+        raise ValueError("striatum dataset needs cfg.path")
+    train_x, train_y = formats.load_labeled_text(os.path.join(cfg.path, "striatum_train_mini.txt"))
+    test_x, test_y = formats.load_labeled_text(os.path.join(cfg.path, "striatum_test_mini.txt"))
+    bundle = DataBundle(train_x, train_y, test_x, test_y, "striatum")
+    return _standardize(bundle, cfg, independent_test=True)
+
+
+@register_dataset("credit_card_fraud")
+def _credit_card(cfg: DataConfig) -> DataBundle:
+    """Kaggle fraud CSV with a 70/30 split (mllib/credit_card_fraud.py:28)."""
+    if cfg.path is None:
+        raise ValueError("credit_card_fraud dataset needs cfg.path (the CSV file)")
+    x, y = formats.load_credit_card_csv(cfg.path)
+    rng = np.random.default_rng(cfg.seed)
+    perm = rng.permutation(len(x))
+    split = int(0.7 * len(x))
+    tr, te = perm[:split], perm[split:]
+    bundle = DataBundle(x[tr], y[tr], x[te], y[te], "credit_card_fraud")
+    return _standardize(bundle, cfg)
+
+
+@register_dataset("gaussian_unbalanced")
+def _gaussian_unbalanced(cfg: DataConfig) -> DataBundle:
+    """Simulated unbalanced clouds (classes/test.py:150-187)."""
+    key = jax.random.key(cfg.seed)
+    train_x, train_y, test_x, test_y = synthetic.make_gaussian_unbalanced(key, 1000)
+    bundle = DataBundle(
+        np.asarray(train_x), np.asarray(train_y),
+        np.asarray(test_x), np.asarray(test_y), "gaussian_unbalanced",
+    )
+    return _standardize(bundle, cfg)

@@ -1,0 +1,51 @@
+"""Feature standardization as pure functions.
+
+Replaces MLlib's ``StandardScaler(withMean=True, withStd=True)``
+(``classes/dataset.py:163-165``, ``:257``) with a stateless fit/transform pair.
+MLlib computes the *sample* standard deviation (ddof=1); we match that so
+accuracy parity against the reference's preprocessed features holds. Zero-variance
+columns divide by 1 instead of 0 (MLlib leaves them at 0 after centering; same
+net effect).
+
+Works on numpy or jax arrays (pure jnp ops) so it can live inside a jitted
+pipeline when the pool is device-resident.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+Array = Union[np.ndarray, jnp.ndarray]
+
+
+class StandardScalerState(NamedTuple):
+    mean: Array  # [d]
+    std: Array   # [d], sample std (ddof=1), zeros replaced by 1
+
+
+def fit_standard_scaler(x: Array, with_mean: bool = True, with_std: bool = True) -> StandardScalerState:
+    """Fit mean/std over rows of ``x`` [n, d]."""
+    xp = jnp if isinstance(x, jnp.ndarray) else np
+    mean = xp.mean(x, axis=0)
+    n = x.shape[0]
+    if n > 1:
+        std = xp.std(x, axis=0, ddof=1)
+    else:
+        std = xp.zeros_like(mean)
+    std = xp.where(std == 0, xp.ones_like(std), std)
+    if not with_mean:
+        mean = xp.zeros_like(mean)
+    if not with_std:
+        std = xp.ones_like(std)
+    return StandardScalerState(mean=mean, std=std)
+
+
+def transform(state: StandardScalerState, x: Array) -> Array:
+    return (x - state.mean) / state.std
+
+
+def fit_transform(x: Array, with_mean: bool = True, with_std: bool = True) -> Array:
+    return transform(fit_standard_scaler(x, with_mean, with_std), x)

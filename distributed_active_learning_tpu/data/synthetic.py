@@ -1,0 +1,115 @@
+"""Synthetic dataset generators.
+
+TPU-native (jax.random, pure-functional) equivalents of the reference's
+generators:
+
+- XOR / checkerboard-parity data: ``final_thesis/dataset/xor_generator.py:3-8``
+  (d-dimensional two-class XOR over quadrant parity; the reference writes
+  N=100000, D=100 to ``xor.txt`` at ``:21-23``).
+- Checkerboard 2x2 / 4x4 / rotated fixtures: the 1000-row files under
+  ``lal_direct_mllib_implementation/data/`` (2 features in [0,1], binary label by
+  cell parity; rotated variant is the same board rotated 45 degrees).
+- Simulated unbalanced Gaussians: ``classes/test.py:150-187`` — two Gaussian
+  clouds with random means/covariances, class-1 prior drawn from [10%, 90%],
+  test set 10x the train size. Used to synthesize LAL-regressor training data.
+- Dense random matrices for similarity benchmarks: ``final_thesis/sqgen.py``.
+
+All generators take an explicit PRNG key and return numpy-compatible jnp arrays.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def make_xor(key: jax.Array, n: int, d: int = 2) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """d-dimensional XOR data: x ~ U[0,1]^d, label = parity of per-dim half-space bits.
+
+    Behavioral twin of ``xor_generator.get_xor_data`` (xor_generator.py:3-8).
+    Returns (features [n, d] float32, labels [n] int32 in {0, 1}).
+    """
+    x = jax.random.uniform(key, (n, d), dtype=jnp.float32)
+    bits = (x > 0.5).astype(jnp.int32)
+    labels = jnp.sum(bits, axis=1) % 2
+    return x, labels.astype(jnp.int32)
+
+
+def make_checkerboard(
+    key: jax.Array, n: int, grid: int = 2
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """2-D checkerboard data on a ``grid x grid`` board over [0,1]^2.
+
+    Fixture-equivalent of ``data/checkerboard{2x2,4x4}_train.txt`` (2 features +
+    binary label by cell parity, loaded at ``classes/dataset.py:149-210``).
+    """
+    x = jax.random.uniform(key, (n, 2), dtype=jnp.float32)
+    cells = jnp.floor(x * grid).astype(jnp.int32)
+    labels = (cells[:, 0] + cells[:, 1]) % 2
+    return x, labels.astype(jnp.int32)
+
+
+def make_rotated_checkerboard(
+    key: jax.Array, n: int, grid: int = 2, angle: float = 0.7853981633974483
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Checkerboard rotated by ``angle`` (default 45deg) about the board center.
+
+    Fixture-equivalent of ``DatasetRotatedCheckerboard2x2``
+    (``classes/dataset.py:217-238``).
+    """
+    x = jax.random.uniform(key, (n, 2), dtype=jnp.float32)
+    c, s = jnp.cos(angle), jnp.sin(angle)
+    centered = x - 0.5
+    un_rot = jnp.stack(
+        [c * centered[:, 0] + s * centered[:, 1], -s * centered[:, 0] + c * centered[:, 1]],
+        axis=1,
+    ) + 0.5
+    cells = jnp.floor(un_rot * grid).astype(jnp.int32)
+    labels = (cells[:, 0] + cells[:, 1]) % 2
+    return x, labels.astype(jnp.int32)
+
+
+def make_gaussian_unbalanced(
+    key: jax.Array, n_train: int, dim: int = 2, test_factor: int = 10
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Two random Gaussian clouds with a random class imbalance in [10%, 90%].
+
+    Behavioral twin of ``DatasetSimulatedUnbalanced`` (``classes/test.py:150-187``):
+    random means/covariances per class, class-1 prior uniform in [0.1, 0.9], test
+    set ``test_factor``x the train size drawn from the same mixture. This is the
+    generator the reference uses to synthesize LAL-regressor training data.
+
+    Returns (train_x, train_y, test_x, test_y).
+    """
+    k_prior, k_mean0, k_mean1, k_cov0, k_cov1, k_tr, k_te = jax.random.split(key, 7)
+    p1 = jax.random.uniform(k_prior, (), minval=0.1, maxval=0.9)
+    mean0 = jax.random.uniform(k_mean0, (dim,), minval=-1.0, maxval=1.0)
+    mean1 = jax.random.uniform(k_mean1, (dim,), minval=-1.0, maxval=1.0)
+
+    def _rand_cov(k):
+        a = jax.random.uniform(k, (dim, dim), minval=-1.0, maxval=1.0)
+        return a @ a.T + 0.1 * jnp.eye(dim)
+
+    cov0, cov1 = _rand_cov(k_cov0), _rand_cov(k_cov1)
+    chol0, chol1 = jnp.linalg.cholesky(cov0), jnp.linalg.cholesky(cov1)
+
+    def _sample(k, n):
+        k_lab, k_pts = jax.random.split(k)
+        y = (jax.random.uniform(k_lab, (n,)) < p1).astype(jnp.int32)
+        z = jax.random.normal(k_pts, (n, dim), dtype=jnp.float32)
+        x0 = z @ chol0.T + mean0
+        x1 = z @ chol1.T + mean1
+        x = jnp.where(y[:, None] == 1, x1, x0)
+        return x.astype(jnp.float32), y
+
+    train_x, train_y = _sample(k_tr, n_train)
+    test_x, test_y = _sample(k_te, n_train * test_factor)
+    return train_x, train_y, test_x, test_y
+
+
+def make_random_matrix(key: jax.Array, n: int, d: int) -> jnp.ndarray:
+    """Dense random matrix like ``sqgen.py`` (vectors_50000x1000.txt) /
+    ``cosine_similarity.py:26`` (3000x500 random vectors)."""
+    return jax.random.uniform(key, (n, d), dtype=jnp.float32)

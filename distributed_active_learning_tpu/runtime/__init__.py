@@ -1,0 +1,16 @@
+"""Runtime: AL pool state, driver loop, checkpointing, tracing, results logging.
+
+Replaces the reference's L5 experiment-driver layer (module-level while-loops in
+``final_thesis/*.py`` and the driver tail of ``classes/active_learner.py:369-384``)
+plus the auxiliary subsystems it lacked (SURVEY.md §5): structured tracing,
+checkpoint/resume of full AL state, and a results logger.
+"""
+
+from distributed_active_learning_tpu.runtime.state import (
+    PoolState,
+    init_pool_state,
+    set_start_state,
+    labeled_count,
+    unlabeled_count,
+    reveal,
+)

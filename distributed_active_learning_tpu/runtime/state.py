@@ -1,0 +1,124 @@
+"""Device-resident active-learning pool state.
+
+The reference keeps the labeled/unlabeled split as two index RDDs re-joined to
+the data every round (``final_thesis/uncertainty_sampling.py:48-55,62-63``;
+``classes/dataset.py:56-130`` ``indicesKnown``/``indicesUnknown``), paying a
+Spark shuffle per round and growing RDD lineage forever. The TPU-native design
+(SURVEY.md §7): the pool is one dense array pinned in HBM and the split is a
+boolean mask updated functionally on device — fixed shapes, no recompiles, no
+host round-trips.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+
+@struct.dataclass
+class PoolState:
+    """Full state of one AL experiment's pool.
+
+    ``oracle_y`` holds every pool label but strategies may only consume labels
+    where ``labeled_mask`` is True — the mask IS the oracle boundary. This
+    mirrors the reference, whose train RDD also physically contains all labels
+    while strategies only join the known-index RDD against it
+    (``active_learner.py:65-67``).
+    """
+
+    x: jnp.ndarray             # [n, d] float32 — pool features
+    oracle_y: jnp.ndarray      # [n] int32 — all labels (revealed via mask)
+    labeled_mask: jnp.ndarray  # [n] bool
+    key: jax.Array             # PRNG key threaded through rounds
+    round: jnp.ndarray         # scalar int32 round counter
+
+    @property
+    def n_pool(self) -> int:
+        return self.x.shape[0]
+
+    @property
+    def unlabeled_mask(self) -> jnp.ndarray:
+        return ~self.labeled_mask
+
+    def visible_y(self, fill: int = -1) -> jnp.ndarray:
+        """Labels with unlabeled entries masked to ``fill`` — what a strategy may see."""
+        return jnp.where(self.labeled_mask, self.oracle_y, fill)
+
+
+def labeled_count(state: PoolState) -> jnp.ndarray:
+    return jnp.sum(state.labeled_mask.astype(jnp.int32))
+
+
+def unlabeled_count(state: PoolState) -> jnp.ndarray:
+    return jnp.sum((~state.labeled_mask).astype(jnp.int32))
+
+
+def init_pool_state(x, y, key: jax.Array) -> PoolState:
+    """Wrap arrays into a fresh all-unlabeled PoolState."""
+    x = jnp.asarray(x, dtype=jnp.float32)
+    y = jnp.asarray(y, dtype=jnp.int32)
+    return PoolState(
+        x=x,
+        oracle_y=y,
+        labeled_mask=jnp.zeros(x.shape[0], dtype=bool),
+        key=key,
+        round=jnp.asarray(0, dtype=jnp.int32),
+    )
+
+
+def set_start_state(state: PoolState, n_start: int) -> PoolState:
+    """Seed the labeled set: one point of each class plus ``n_start - 2`` extras.
+
+    Functional equivalent of ``Dataset.setStartState``
+    (``classes/dataset.py:56-130``): the reference shuffles the class-1 and
+    class-0 index RDDs by random keys and takes one of each (``:90-106``), then
+    shuffles the remainder and adds ``nStart - 2`` more (``:110-124``); the rest
+    become ``indicesUnknown`` (``:128-130``). Here the same selection is a pair
+    of masked argmaxes over random priorities plus a top-(n_start-2) over the
+    remainder — one jittable function, no shuffles.
+    """
+    n = state.n_pool
+    if n_start > n:
+        raise ValueError(f"n_start={n_start} exceeds pool size {n}")
+    # The class-seed step always labels one point per class, so the effective
+    # minimum is 2 (the reference behaves identically: dataset.py:90-106).
+    if not isinstance(state.oracle_y, jax.core.Tracer):
+        y = np.asarray(state.oracle_y)
+        if not ((y == 1).any() and (y == 0).any()):
+            raise ValueError(
+                "set_start_state needs at least one point of each class in the "
+                "pool (the reference's take(1) on an empty class RDD would fail "
+                "the same way: dataset.py:90-106)"
+            )
+    key, k_pos, k_neg, k_rest = jax.random.split(state.key, 4)
+
+    pri_pos = jax.random.uniform(k_pos, (n,))
+    pri_neg = jax.random.uniform(k_neg, (n,))
+    pos_mask = state.oracle_y == 1
+    neg_mask = state.oracle_y == 0
+    pos_pick = jnp.argmax(jnp.where(pos_mask, pri_pos, -1.0))
+    neg_pick = jnp.argmax(jnp.where(neg_mask, pri_neg, -1.0))
+
+    mask = jnp.zeros(n, dtype=bool).at[pos_pick].set(True).at[neg_pick].set(True)
+
+    n_extra = max(n_start - 2, 0)
+    if n_extra > 0:
+        pri_rest = jax.random.uniform(k_rest, (n,))
+        _, extra_idx = jax.lax.top_k(jnp.where(mask, -1.0, pri_rest), n_extra)
+        mask = mask.at[extra_idx].set(True)
+
+    return state.replace(labeled_mask=mask, key=key)
+
+
+def reveal(state: PoolState, picked_idx: jnp.ndarray) -> PoolState:
+    """Label the picked pool indices (the oracle call) and advance the round.
+
+    Replaces the reference's set-algebra pool update
+    (``subtractByKey``/``union`` at ``uncertainty_sampling.py:111-112``;
+    ``filter`` + ``union`` at ``active_learner.py:209-215``) with one scatter
+    into the mask.
+    """
+    mask = state.labeled_mask.at[picked_idx].set(True)
+    return state.replace(labeled_mask=mask, round=state.round + 1)

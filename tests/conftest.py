@@ -1,0 +1,29 @@
+"""Test harness: force an 8-device virtual CPU mesh before JAX initializes.
+
+The reference never simulated its cluster (local-mode master only exists as
+commented-out code, ``classes/dataset.py:16-17``); here every multi-device code
+path is exercised on CPU via XLA's virtual host devices (SURVEY.md §4).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture()
+def key():
+    return jax.random.key(0)

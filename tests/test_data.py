@@ -1,0 +1,112 @@
+"""Data layer: parsers vs hand-built files, scaler vs numpy oracle, generators."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distributed_active_learning_tpu.data import (
+    load_labeled_text,
+    load_credit_card_csv,
+    load_triplet_text,
+    write_triplet_text,
+    fit_standard_scaler,
+    transform,
+    fit_transform,
+    make_xor,
+    make_checkerboard,
+    make_rotated_checkerboard,
+    make_gaussian_unbalanced,
+    DataBundle,
+    get_dataset,
+    available_datasets,
+)
+from distributed_active_learning_tpu.config import DataConfig
+
+
+def test_load_labeled_text_label_last_and_remap(tmp_path):
+    p = tmp_path / "striatum_like.txt"
+    p.write_text("0.5 1.25 -1\n1.0 2.0 1\n3.0 4.0 -1\n")
+    x, y = load_labeled_text(str(p))
+    np.testing.assert_allclose(x, [[0.5, 1.25], [1.0, 2.0], [3.0, 4.0]])
+    np.testing.assert_array_equal(y, [0, 1, 0])  # -1 -> 0 per dataset.py:259
+
+
+def test_load_credit_card_csv(tmp_path):
+    p = tmp_path / "fraud.csv"
+    p.write_text('Time,V1,V2,Class\n0.0,1.5,-2.5,"0"\n1.0,0.25,3.5,"1"\n')
+    x, y = load_credit_card_csv(str(p))
+    np.testing.assert_allclose(x, [[0.0, 1.5, -2.5], [1.0, 0.25, 3.5]])
+    np.testing.assert_array_equal(y, [0, 1])
+
+
+def test_triplet_roundtrip(tmp_path):
+    rng = np.random.default_rng(3)
+    mat = rng.normal(size=(3, 4)).astype(np.float32)
+    p = tmp_path / "trip.txt"
+    write_triplet_text(str(p), mat)
+    back = load_triplet_text(str(p), shape=(3, 4))
+    # exact: .9g suffices for a float32 roundtrip
+    np.testing.assert_array_equal(back, mat)
+
+
+def test_scaler_matches_numpy_ddof1():
+    rng = np.random.default_rng(0)
+    x = rng.normal(3.0, 2.0, size=(100, 5)).astype(np.float32)
+    st = fit_standard_scaler(x)
+    np.testing.assert_allclose(st.mean, x.mean(0), rtol=1e-5)
+    np.testing.assert_allclose(st.std, x.std(0, ddof=1), rtol=1e-5)
+    z = transform(st, x)
+    np.testing.assert_allclose(z.mean(0), 0.0, atol=1e-4)
+    np.testing.assert_allclose(z.std(0, ddof=1), 1.0, rtol=1e-4)
+
+
+def test_scaler_zero_variance_column():
+    x = np.ones((10, 3), dtype=np.float32)
+    z = fit_transform(x)
+    assert np.all(np.isfinite(np.asarray(z)))
+
+
+def test_xor_labels_are_parity(key):
+    x, y = make_xor(key, 512, d=4)
+    bits = (np.asarray(x) > 0.5).astype(int)
+    np.testing.assert_array_equal(np.asarray(y), bits.sum(1) % 2)
+
+
+def test_checkerboard_cells(key):
+    x, y = make_checkerboard(key, 512, grid=2)
+    cells = np.floor(np.asarray(x) * 2).astype(int)
+    np.testing.assert_array_equal(np.asarray(y), (cells[:, 0] + cells[:, 1]) % 2)
+    # both classes present
+    assert 0 < np.asarray(y).sum() < 512
+
+
+def test_rotated_checkerboard_two_classes(key):
+    _, y = make_rotated_checkerboard(key, 1000)
+    frac = np.asarray(y).mean()
+    assert 0.2 < frac < 0.8
+
+
+def test_gaussian_unbalanced_shapes_and_imbalance(key):
+    tx, ty, ex, ey = make_gaussian_unbalanced(key, 500, dim=3, test_factor=10)
+    assert tx.shape == (500, 3) and ex.shape == (5000, 3)
+    p1 = float(jnp.mean(ey.astype(jnp.float32)))
+    assert 0.05 < p1 < 0.95
+
+
+def test_registry_checkerboard_bundle():
+    cfg = DataConfig(name="checkerboard2x2", seed=1)
+    b = get_dataset(cfg)
+    assert isinstance(b, DataBundle)
+    assert b.train_x.shape == (1000, 2) and b.test_x.shape == (1000, 2)
+    # standardized
+    assert abs(b.train_x.mean()) < 0.1
+    assert {"checkerboard2x2", "checkerboard4x4", "striatum",
+            "credit_card_fraud", "xor", "gaussian_unbalanced"} <= set(available_datasets())
+
+
+def test_registry_subsampling():
+    cfg = DataConfig(name="checkerboard2x2", n_samples=200, seed=2)
+    b = get_dataset(cfg)
+    assert b.train_x.shape[0] == 200
+    assert b.test_x.shape[0] == 1000  # test set untouched (density_weighting subsamples pool only)

@@ -1,0 +1,79 @@
+"""PoolState invariants: seeding, reveal, mask bookkeeping."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_active_learning_tpu.runtime import (
+    PoolState,
+    init_pool_state,
+    set_start_state,
+    labeled_count,
+    unlabeled_count,
+    reveal,
+)
+
+
+def _mk_state(key, n=100, d=3, frac_pos=0.3):
+    kx, ky = jax.random.split(key)
+    x = jax.random.normal(kx, (n, d))
+    y = (jax.random.uniform(ky, (n,)) < frac_pos).astype(jnp.int32)
+    return init_pool_state(x, y, key)
+
+
+def test_init_all_unlabeled(key):
+    s = _mk_state(key)
+    assert int(labeled_count(s)) == 0
+    assert int(unlabeled_count(s)) == s.n_pool
+
+
+def test_set_start_state_counts_and_class_coverage(key):
+    s = set_start_state(_mk_state(key), n_start=10)
+    assert int(labeled_count(s)) == 10
+    y = np.asarray(s.oracle_y)
+    m = np.asarray(s.labeled_mask)
+    # one of each class guaranteed (dataset.py:90-106 semantics)
+    assert (y[m] == 1).any() and (y[m] == 0).any()
+
+
+def test_set_start_state_nstart_2(key):
+    s = set_start_state(_mk_state(key), n_start=2)
+    assert int(labeled_count(s)) == 2
+
+
+def test_set_start_state_is_jittable(key):
+    s = _mk_state(key)
+    jitted = jax.jit(lambda st: set_start_state(st, 10))
+    out = jitted(s)
+    assert int(labeled_count(out)) == 10
+
+
+def test_reveal_adds_and_advances(key):
+    s = set_start_state(_mk_state(key), n_start=4)
+    unlabeled = np.flatnonzero(~np.asarray(s.labeled_mask))[:5]
+    s2 = reveal(s, jnp.asarray(unlabeled))
+    assert int(labeled_count(s2)) == 9
+    assert int(s2.round) == int(s.round) + 1
+
+
+def test_reveal_idempotent_on_already_labeled(key):
+    s = set_start_state(_mk_state(key), n_start=4)
+    labeled = np.flatnonzero(np.asarray(s.labeled_mask))[:2]
+    s2 = reveal(s, jnp.asarray(labeled))
+    assert int(labeled_count(s2)) == 4  # scatter of True into True is a no-op
+
+
+def test_visible_labels_hide_unlabeled(key):
+    s = set_start_state(_mk_state(key), n_start=6)
+    vis = np.asarray(s.visible_y(fill=-1))
+    m = np.asarray(s.labeled_mask)
+    assert (vis[~m] == -1).all()
+    assert (vis[m] == np.asarray(s.oracle_y)[m]).all()
+
+
+def test_pool_state_is_pytree(key):
+    s = _mk_state(key)
+    leaves = jax.tree_util.tree_leaves(s)
+    assert len(leaves) >= 4
+    s_moved = jax.tree_util.tree_map(lambda a: a, s)
+    assert isinstance(s_moved, PoolState)
