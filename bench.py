@@ -1,0 +1,98 @@
+"""Headline benchmark: acquisition-scoring throughput over the unlabeled pool.
+
+Workload (BASELINE.json config 1): the credit-card-fraud pool shape —
+284,807 x 30 features — scored by a 100-tree random forest with
+least-confidence uncertainty + window top-k, i.e. one full acquisition round's
+device work (``mllib/credit_card_fraud.py`` pool + ``uncertainty_sampling.py``
+strategy). The CSV itself is not redistributable, so features are synthesized
+at the same shape; tree traversal cost is shape-driven (feature values only
+steer branch directions), so throughput is representative.
+
+Baseline derivation (BASELINE.md): the reference's only persisted distributed
+scoring measurement is the LAL regressor pass — 2000 trees over a 1000-point
+pool in 616.87 s on the 8-executor Spark cluster (``classes/RESULTS.txt:17``)
+= 3,242 tree-point evals/s. At this workload's 100 trees/point that is
+~32.4 scores/s. The north-star target is >=50x (BASELINE.json).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+# 2000 trees * 1000 points / 616.87 s (classes/RESULTS.txt:17), at 100 trees.
+SPARK_TREE_POINTS_PER_SEC = 2000 * 1000 / 616.87
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--pool", type=int, default=284_807)  # credit-card fraud rows
+    ap.add_argument("--features", type=int, default=30)
+    ap.add_argument("--trees", type=int, default=100)  # mllib/credit_card_fraud.py:35
+    ap.add_argument("--depth", type=int, default=8)
+    ap.add_argument("--window", type=int, default=100)
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--train-rows", type=int, default=5000)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_active_learning_tpu.config import ForestConfig
+    from distributed_active_learning_tpu.models.forest import fit_forest_classifier
+    from distributed_active_learning_tpu.ops.topk import select_bottom_k
+    from distributed_active_learning_tpu.ops.scoring import uncertainty_score
+    from distributed_active_learning_tpu.ops.trees import predict_votes
+
+    rng = np.random.default_rng(0)
+    pool = rng.normal(size=(args.pool, args.features)).astype(np.float32)
+    train_x = rng.normal(size=(args.train_rows, args.features)).astype(np.float32)
+    train_y = (train_x[:, 0] + 0.3 * train_x[:, 1] > 0).astype(np.int32)
+
+    forest = fit_forest_classifier(
+        train_x, train_y, ForestConfig(n_trees=args.trees, max_depth=args.depth)
+    )
+    pool_dev = jax.device_put(jnp.asarray(pool))
+    unlabeled = jnp.ones(args.pool, dtype=bool)
+
+    window = args.window  # closed over as a Python int -> static under jit
+
+    @jax.jit
+    def acquisition(forest, x, mask):
+        votes = predict_votes(forest, x)
+        scores = uncertainty_score(votes.astype(jnp.float32) / forest.n_trees)
+        vals, idx = select_bottom_k(scores, mask, window)
+        return scores, idx
+
+    # Warmup / compile.
+    scores, idx = acquisition(forest, pool_dev, unlabeled)
+    jax.block_until_ready((scores, idx))
+
+    times = []
+    for _ in range(args.iters):
+        t0 = time.perf_counter()
+        scores, idx = acquisition(forest, pool_dev, unlabeled)
+        jax.block_until_ready((scores, idx))
+        times.append(time.perf_counter() - t0)
+
+    best = min(times)
+    scores_per_sec = args.pool / best
+    spark_scores_per_sec = SPARK_TREE_POINTS_PER_SEC / args.trees
+    print(
+        json.dumps(
+            {
+                "metric": "acquisition_scores_per_sec",
+                "value": round(scores_per_sec, 1),
+                "unit": f"scores/s ({args.pool}x{args.features} pool, {args.trees} trees, depth {args.depth})",
+                "vs_baseline": round(scores_per_sec / spark_scores_per_sec, 1),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,13 @@
+"""Base learners: random forests (packed for on-device scoring) and neural models.
+
+Replaces the reference's L2 model layer (MLlib RandomForest classifier/regressor,
+``final_thesis/uncertainty_sampling.py:71-76``,
+``mllib/mllib_randomforest_regression_lal_randomtree_dataset.py:30``).
+"""
+
+from distributed_active_learning_tpu.models.forest import (
+    fit_forest_classifier,
+    fit_forest_regressor,
+    pack_sklearn_forest,
+    forest_accuracy,
+)

@@ -1,0 +1,135 @@
+"""Random-forest base learner: host-side fit, device-side scoring.
+
+The reference trains in the JVM (``RandomForest.trainClassifier``,
+``uncertainty_sampling.py:71-76``) and scores with sequential per-tree Spark
+jobs. The TPU-native split (SURVEY.md §7 step 2): training happens host-side on
+the (small, growing) labeled subset — an honest equivalent of the JVM fit —
+and the fitted trees are packed once into dense :class:`PackedForest` tensors
+for single-launch device scoring of the (large) pool. The packed shape is fixed
+by the config's node budget so repeated rounds never trigger recompilation.
+
+An on-device histogram-split trainer is the stretch path (SURVEY.md §7 "hard
+parts"); host-fit is the parity fast-path because the pool-scoring step, not the
+fit, dominates the reference's round time (BASELINE.md: 12.56 s fit vs 1600+ s
+scoring for LAL).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import jax.numpy as jnp
+from sklearn.ensemble import RandomForestClassifier, RandomForestRegressor
+
+from distributed_active_learning_tpu.config import ForestConfig
+from distributed_active_learning_tpu.ops.trees import LEAF, PackedForest, pad_forest
+
+
+def pack_sklearn_forest(
+    model, node_budget: Optional[int] = None, max_depth: Optional[int] = None
+) -> PackedForest:
+    """Pack a fitted sklearn forest into dense node tensors.
+
+    For classifiers, ``value`` is P(class 1) at each node (vote fractions from
+    the node's class counts); for regressors it is the node mean. Trees are
+    right-padded with self-looping leaves to the largest node count (or
+    ``node_budget``).
+    """
+    estimators = model.estimators_
+    n_nodes = max(e.tree_.node_count for e in estimators)
+    if node_budget is not None:
+        if n_nodes > node_budget:
+            raise ValueError(f"fitted trees need {n_nodes} nodes > budget {node_budget}")
+        n_nodes = node_budget
+    # Traversal iteration count. Using the config's depth bound (not the fitted
+    # depth, which varies round to round) keeps the static shape stable so the
+    # jitted round function never recompiles.
+    if max_depth is not None:
+        depth = max(max_depth, 1)
+    else:
+        depth = max(int(e.tree_.max_depth) for e in estimators)
+
+    T = len(estimators)
+    feature = np.full((T, n_nodes), LEAF, dtype=np.int32)
+    threshold = np.zeros((T, n_nodes), dtype=np.float32)
+    left = np.tile(np.arange(n_nodes, dtype=np.int32), (T, 1))
+    right = left.copy()
+    value = np.zeros((T, n_nodes), dtype=np.float32)
+
+    is_classifier = isinstance(model, RandomForestClassifier)
+    for t, est in enumerate(estimators):
+        tr = est.tree_
+        m = tr.node_count
+        # sklearn marks leaves with children_left == -1; internal nodes route
+        # left iff x[feature] <= threshold — same convention as our kernel.
+        leaf_mask = tr.children_left < 0
+        feature[t, :m] = np.where(leaf_mask, LEAF, tr.feature)
+        threshold[t, :m] = np.where(leaf_mask, 0.0, tr.threshold).astype(np.float32)
+        left[t, :m] = np.where(leaf_mask, np.arange(m), tr.children_left)
+        right[t, :m] = np.where(leaf_mask, np.arange(m), tr.children_right)
+        if is_classifier:
+            counts = tr.value[:, 0, :]  # [m, n_classes] (class counts / weights)
+            if counts.shape[1] == 1:
+                # single-class fit (tiny labeled sets early in AL)
+                only = float(model.classes_[0])
+                value[t, :m] = only
+            else:
+                pos_col = int(np.flatnonzero(model.classes_ == 1)[0]) if 1 in model.classes_ else 1
+                totals = counts.sum(axis=1)
+                value[t, :m] = counts[:, pos_col] / np.maximum(totals, 1e-9)
+        else:
+            value[t, :m] = tr.value[:, 0, 0].astype(np.float32)
+
+    return PackedForest(
+        feature=jnp.asarray(feature),
+        threshold=jnp.asarray(threshold),
+        left=jnp.asarray(left),
+        right=jnp.asarray(right),
+        value=jnp.asarray(value),
+        max_depth=depth,
+    )
+
+
+def fit_forest_classifier(
+    x: np.ndarray, y: np.ndarray, cfg: ForestConfig, seed: Optional[int] = None
+) -> PackedForest:
+    """Fit a RF classifier on the labeled subset and pack it.
+
+    Mirrors ``RandomForest.trainClassifier(numClasses=2, numTrees=cfg.n_trees,
+    maxDepth=cfg.max_depth, maxBins=cfg.max_bins, 'gini')``
+    (``uncertainty_sampling.py:71-76``).
+    """
+    model = RandomForestClassifier(
+        n_estimators=cfg.n_trees,
+        max_depth=cfg.max_depth,
+        criterion=cfg.criterion,
+        random_state=cfg.seed if seed is None else seed,
+        n_jobs=-1,
+    )
+    model.fit(np.asarray(x), np.asarray(y))
+    return pack_sklearn_forest(model, node_budget=cfg.resolved_node_budget, max_depth=cfg.max_depth)
+
+
+def fit_forest_regressor(
+    x: np.ndarray, y: np.ndarray, cfg: ForestConfig, seed: Optional[int] = None
+) -> PackedForest:
+    """Fit a RF regressor and pack it (the LAL-regressor path,
+    ``mllib_randomforest_regression_lal_randomtree_dataset.py:30``)."""
+    model = RandomForestRegressor(
+        n_estimators=cfg.n_trees,
+        max_depth=cfg.max_depth,
+        random_state=cfg.seed if seed is None else seed,
+        n_jobs=-1,
+    )
+    model.fit(np.asarray(x), np.asarray(y))
+    return pack_sklearn_forest(model, node_budget=cfg.resolved_node_budget, max_depth=cfg.max_depth)
+
+
+def forest_accuracy(forest: PackedForest, x, y) -> float:
+    """Test-set accuracy of the packed forest (the reference's per-round eval,
+    ``uncertainty_sampling.py:79-83``)."""
+    from distributed_active_learning_tpu.ops.trees import predict_proba
+
+    pred = np.asarray(predict_proba(forest, jnp.asarray(x))) > 0.5
+    return float(np.mean(pred.astype(np.int32) == np.asarray(y)))

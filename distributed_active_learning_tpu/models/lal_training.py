@@ -1,0 +1,159 @@
+"""Synthesize LAL-regressor training data and train the packed regressor.
+
+The reference trains its 2000-tree LAL regressor offline on
+``lal_randomtree_simulatedunbalanced_big.txt`` — a pre-generated file of
+(5 features -> expected-error-reduction) rows
+(``mllib/mllib_randomforest_regression_lal_randomtree_dataset.py:20-50``; the
+commented train-and-cache block at ``active_learner.py:354-365``). The file's
+*generator* is not in the repo, but its procedure is the LAL "random tree"
+method over the simulated unbalanced Gaussians (``classes/test.py:150-187``):
+
+  repeat: draw a random unbalanced 2-Gaussian dataset; label a random subset;
+  fit a small RF; measure test error; pick a random unlabeled candidate,
+  compute its 5 features; add it to the labeled set, refit, re-measure;
+  the regression target is the error reduction.
+
+This module reproduces that procedure (host-side sklearn, one-time offline
+cost), or loads a pre-synthesized reference-format text file, and packs the
+fitted regressor for single-launch device scoring.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Optional, Tuple
+
+import jax
+import numpy as np
+from sklearn.ensemble import RandomForestClassifier
+
+from distributed_active_learning_tpu.config import ForestConfig
+from distributed_active_learning_tpu.data.formats import _text_to_matrix
+from distributed_active_learning_tpu.data.synthetic import make_gaussian_unbalanced
+from distributed_active_learning_tpu.models.forest import fit_forest_regressor
+from distributed_active_learning_tpu.ops.trees import PackedForest
+
+
+def _lal_point_features(
+    model: RandomForestClassifier,
+    candidate: np.ndarray,
+    labeled_y: np.ndarray,
+    pool_x: np.ndarray,
+) -> np.ndarray:
+    """The 5 LAL features for one candidate point (host/numpy twin of
+    ``strategies.lal.lal_features``; order f_1, f_2, f_3, f_6, f_8 per
+    ``active_learner.py:280-296``)."""
+    pos_col = list(model.classes_).index(1) if 1 in model.classes_ else None
+
+    def tree_votes(x):
+        if pos_col is None:
+            return np.zeros((len(model.estimators_), x.shape[0]))
+        return np.stack(
+            [est.predict_proba(x)[:, pos_col] > 0.5 for est in model.estimators_]
+        ).astype(np.float64)
+
+    votes_cand = tree_votes(candidate[None, :])[:, 0]
+    n_trees = len(model.estimators_)
+    f1 = votes_cand.mean()
+    p = votes_cand.sum() / n_trees
+    f2 = np.sqrt(p * (1 - p))
+    f3 = float((labeled_y == 1).mean()) if len(labeled_y) else 0.0
+    votes_pool = tree_votes(pool_x)
+    p_pool = votes_pool.mean(axis=0)
+    f6 = float(np.sqrt(p_pool * (1 - p_pool)).mean())
+    f8 = float(len(labeled_y))
+    return np.array([f1, f2, f3, f6, f8], dtype=np.float32)
+
+
+def generate_lal_dataset(
+    seed: int = 0,
+    n_experiments: int = 60,
+    candidates_per_experiment: int = 8,
+    pool_size: int = 200,
+    n_trees: int = 10,
+    max_depth: int = 6,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Monte-Carlo synthesis of (features [m, 5], error-reduction targets [m])."""
+    rng = np.random.default_rng(seed)
+    feats, targets = [], []
+    for e in range(n_experiments):
+        key = jax.random.key(seed * 100003 + e)
+        tx, ty, ex, ey = make_gaussian_unbalanced(key, pool_size, dim=2)
+        tx, ty = np.asarray(tx), np.asarray(ty)
+        ex, ey = np.asarray(ex), np.asarray(ey)
+        if len(np.unique(ty)) < 2:
+            continue
+        # random labeled subset containing both classes
+        n_lab = int(rng.integers(4, max(pool_size // 4, 6)))
+        pos = rng.permutation(np.flatnonzero(ty == 1))
+        neg = rng.permutation(np.flatnonzero(ty == 0))
+        rest = rng.permutation(np.setdiff1d(np.arange(pool_size), [pos[0], neg[0]]))
+        lab_idx = np.concatenate([[pos[0], neg[0]], rest[: max(n_lab - 2, 0)]])
+        unlab_idx = np.setdiff1d(np.arange(pool_size), lab_idx)
+        if len(unlab_idx) == 0:
+            continue
+
+        model = RandomForestClassifier(
+            n_estimators=n_trees, max_depth=max_depth, random_state=int(rng.integers(1 << 30))
+        )
+        model.fit(tx[lab_idx], ty[lab_idx])
+        err0 = 1.0 - model.score(ex, ey)
+
+        for c in rng.choice(unlab_idx, size=min(candidates_per_experiment, len(unlab_idx)), replace=False):
+            fv = _lal_point_features(model, tx[c], ty[lab_idx], tx[unlab_idx])
+            aug = np.concatenate([lab_idx, [c]])
+            m2 = RandomForestClassifier(
+                n_estimators=n_trees, max_depth=max_depth, random_state=int(rng.integers(1 << 30))
+            )
+            m2.fit(tx[aug], ty[aug])
+            err1 = 1.0 - m2.score(ex, ey)
+            feats.append(fv)
+            targets.append(err0 - err1)
+    return np.stack(feats), np.asarray(targets, dtype=np.float32)
+
+
+def train_lal_regressor(
+    features: np.ndarray,
+    targets: np.ndarray,
+    n_trees: int = 200,
+    max_depth: int = 10,
+    seed: int = 0,
+) -> PackedForest:
+    """Fit + pack the error-reduction regressor (the reference uses 2000 trees,
+    ``active_learner.py:357``; 200 is ample at our data sizes and still one
+    XLA launch to evaluate)."""
+    cfg = ForestConfig(n_trees=n_trees, max_depth=max_depth, seed=seed)
+    return fit_forest_regressor(features, targets, cfg)
+
+
+_CACHE: dict = {}
+
+
+def load_or_train_lal_regressor(options: Mapping) -> PackedForest:
+    """Resolve the LAL regressor from strategy options.
+
+    ``options['lal_data_path']``: reference-format text file (5 features +
+    target, whitespace, target last) like ``lal_randomtree_simulatedunbalanced_big.txt``.
+    Otherwise synthesizes a small dataset on the fly (cached per options).
+    """
+    key = tuple(sorted((k, str(v)) for k, v in options.items()))
+    if key in _CACHE:
+        return _CACHE[key]
+    path: Optional[str] = options.get("lal_data_path")
+    if path:
+        # single parse (native fast path when built); targets stay float
+        raw = _text_to_matrix(path, None)
+        feats, targets = raw[:, :-1], raw[:, -1]
+    else:
+        feats, targets = generate_lal_dataset(
+            seed=int(options.get("lal_seed", 0)),
+            n_experiments=int(options.get("lal_experiments", 60)),
+        )
+    packed = train_lal_regressor(
+        feats,
+        targets,
+        n_trees=int(options.get("lal_trees", 200)),
+        max_depth=int(options.get("lal_depth", 10)),
+        seed=int(options.get("lal_seed", 0)),
+    )
+    _CACHE[key] = packed
+    return packed

@@ -1,0 +1,23 @@
+"""Jitted compute kernels: tree traversal, similarity, scoring, selection.
+
+These replace the reference's L2 MLlib ops (SURVEY.md §1): per-tree
+``DecisionTreeModel.predict`` Spark jobs become one vmapped traversal, BlockMatrix
+similarity multiplies become blocked MXU matmuls, and distributed sort+take
+becomes ``lax.top_k``.
+"""
+
+from distributed_active_learning_tpu.ops.trees import (
+    PackedForest,
+    predict_leaves,
+    predict_proba,
+    predict_votes,
+    predict_value,
+)
+from distributed_active_learning_tpu.ops.scoring import (
+    uncertainty_score,
+    positive_entropy,
+    full_entropy,
+    margin_score,
+    vote_sd,
+)
+from distributed_active_learning_tpu.ops.topk import select_top_k, select_bottom_k

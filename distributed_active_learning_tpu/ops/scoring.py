@@ -1,0 +1,52 @@
+"""Acquisition scoring primitives (pure functions over probabilities/votes).
+
+Each function reproduces a scoring rule from the reference, cited inline. All
+operate elementwise on arrays of pool size and are safe under jit/vmap/shard_map.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def uncertainty_score(p_pos: jnp.ndarray) -> jnp.ndarray:
+    """Least-confidence distance from the decision boundary.
+
+    The reference computes ``abs(0.5 - (1 - votes/n))`` over positive-vote
+    fractions and picks the *minimum* (``uncertainty_sampling.py:98,106``;
+    ``active_learner.py:197,203``). With ``p_pos = votes/n`` this is
+    ``abs(0.5 - (1 - p_pos)) == abs(p_pos - 0.5)``. Lower = more uncertain.
+    """
+    return jnp.abs(0.5 - (1.0 - p_pos))
+
+
+def positive_entropy(p_pos: jnp.ndarray, eps: float = 1e-12) -> jnp.ndarray:
+    """The reference's one-sided 'true entropy' ``-(1-p) * log2(1-p)``
+    (``density_weighting.py:148``) — kept verbatim for parity (it is not the
+    full binary entropy; the reference only uses the negative-class term)."""
+    q = jnp.clip(1.0 - p_pos, eps, 1.0)
+    return -q * jnp.log2(q)
+
+
+def full_entropy(p_pos: jnp.ndarray, eps: float = 1e-12) -> jnp.ndarray:
+    """Standard binary entropy in bits — the statistically-correct variant the
+    reference approximates; exposed for the neural/deep-AL configs."""
+    p = jnp.clip(p_pos, eps, 1.0 - eps)
+    return -(p * jnp.log2(p) + (1.0 - p) * jnp.log2(1.0 - p))
+
+
+def margin_score(p_pos: jnp.ndarray) -> jnp.ndarray:
+    """Margin between top-2 class probabilities (binary case: ``|2p - 1|``).
+    Lower = more uncertain. Not in the reference; standard AL companion."""
+    return jnp.abs(2.0 * p_pos - 1.0)
+
+
+def vote_sd(votes: jnp.ndarray, n_trees: int) -> jnp.ndarray:
+    """Standard deviation of per-tree Bernoulli votes.
+
+    Reference ``getSD(x, n)`` (``active_learner.py:232-236``): with ``x``
+    positive votes out of ``n`` trees, the vote sample has mean ``x/n`` and
+    SD ``sqrt((x/n) * (1 - x/n))`` — LAL feature f_2 (``active_learner.py:283``).
+    """
+    p = votes / n_trees
+    return jnp.sqrt(p * (1.0 - p))

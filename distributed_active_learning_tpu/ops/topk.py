@@ -1,0 +1,43 @@
+"""Masked top-k / bottom-k selection over the unlabeled pool.
+
+Replaces the reference's distributed ``sortBy(score).take(window)``
+(``uncertainty_sampling.py:106-109``, ``density_weighting.py:168-172``) — a
+full shuffle sort plus driver round-trip — with ``lax.top_k`` over
+mask-neutralized scores: already-labeled points are forced to -inf (or +inf)
+so they can never be selected (SURVEY.md §7 "hard parts").
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = jnp.float32(-jnp.inf)
+POS_INF = jnp.float32(jnp.inf)
+
+
+def select_top_k(
+    scores: jnp.ndarray, unlabeled_mask: jnp.ndarray, k: int
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Indices of the k highest-scoring unlabeled points.
+
+    Returns ``(values [k], indices [k])``. If fewer than k points are
+    unlabeled, the tail indices point at -inf entries; callers scatter into the
+    labeled mask, where re-labeling a labeled point is a no-op — matching the
+    reference's behavior of just taking what remains.
+    """
+    masked = jnp.where(unlabeled_mask, scores, NEG_INF)
+    return lax.top_k(masked, k)
+
+
+def select_bottom_k(
+    scores: jnp.ndarray, unlabeled_mask: jnp.ndarray, k: int
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Indices of the k lowest-scoring unlabeled points (ascending selection,
+    e.g. least-confidence: ``sortBy`` ascending + take at
+    ``uncertainty_sampling.py:106-109``)."""
+    masked = jnp.where(unlabeled_mask, scores, POS_INF)
+    vals, idx = lax.top_k(-masked, k)
+    return -vals, idx

@@ -1,0 +1,119 @@
+"""Packed tree-ensemble representation and vmapped traversal kernels.
+
+The reference scores the pool one tree at a time: a Python loop over
+``model._java_model.trees()`` launches ``n_estimators`` sequential Spark jobs,
+each a full pool scan, because the JVM tree objects are not serializable
+(``classes/active_learner.py:169-184``; ``final_thesis/uncertainty_sampling.py:88-93``).
+Vote aggregation is then a shuffle (``groupByKey().mapValues(sum)``,
+``uncertainty_sampling.py:96``).
+
+Here the whole forest is a packed tensor — one int/float array per node field,
+shaped ``[n_trees, n_nodes]`` — and prediction is a fixed-depth gather loop
+vmapped over trees and points: every tree and every point is scored in a single
+XLA launch, and the vote reduction is a dense axis-sum. Shapes are static (trees
+padded to a node budget), so AL rounds never recompile.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+# Sentinel feature id marking a leaf node.
+LEAF = -1
+
+
+@struct.dataclass
+class PackedForest:
+    """A forest as dense node arrays.
+
+    ``feature[t, i] == LEAF`` marks a leaf; internal nodes route a point ``x``
+    left iff ``x[feature] <= threshold`` (sklearn/MLlib convention). ``value``
+    holds, per node, the prediction payload: P(class 1) at that node for
+    classifiers, the regression value for regressors (valid at every node so
+    truncated-depth traversal still returns a sensible estimate).
+
+    Padding trees to a common ``n_nodes`` uses self-looping leaves
+    (``left == right == i``), which are fixed points of the traversal.
+    """
+
+    feature: jnp.ndarray    # [T, N] int32, LEAF for leaves
+    threshold: jnp.ndarray  # [T, N] float32
+    left: jnp.ndarray       # [T, N] int32
+    right: jnp.ndarray      # [T, N] int32
+    value: jnp.ndarray      # [T, N] float32
+    max_depth: int = struct.field(pytree_node=False, default=32)
+
+    @property
+    def n_trees(self) -> int:
+        return self.feature.shape[0]
+
+    @property
+    def n_nodes(self) -> int:
+        return self.feature.shape[1]
+
+
+def _traverse_one(forest: PackedForest, x: jnp.ndarray) -> jnp.ndarray:
+    """Route one point through every tree; returns the leaf value per tree [T]."""
+    T = forest.n_trees
+    t_idx = jnp.arange(T)
+
+    def step(_, nodes):
+        feat = forest.feature[t_idx, nodes]          # [T]
+        thr = forest.threshold[t_idx, nodes]         # [T]
+        go_left = x[jnp.maximum(feat, 0)] <= thr     # [T] (clamped gather on leaves)
+        nxt = jnp.where(go_left, forest.left[t_idx, nodes], forest.right[t_idx, nodes])
+        return jnp.where(feat == LEAF, nodes, nxt)
+
+    # Derive the initial nodes from both inputs (not a fresh constant) so the
+    # loop carry inherits the union of their varying-axis types under
+    # shard_map (forest varies over 'model', the point over 'data').
+    nodes0 = jnp.zeros_like(forest.feature[:, 0]) + (x[0] * 0).astype(forest.feature.dtype)
+    nodes = jax.lax.fori_loop(0, forest.max_depth, step, nodes0)
+    return forest.value[t_idx, nodes]
+
+
+def predict_leaves(forest: PackedForest, x: jnp.ndarray) -> jnp.ndarray:
+    """Per-tree leaf values for a batch: ``x [n, d] -> [n, T]``.
+
+    This is the single-launch replacement for the reference's per-tree
+    Spark-job loop (``active_learner.py:172-184``).
+    """
+    return jax.vmap(lambda p: _traverse_one(forest, p))(x)
+
+
+def predict_proba(forest: PackedForest, x: jnp.ndarray) -> jnp.ndarray:
+    """P(class 1) per point as the mean of per-tree leaf probabilities [n]."""
+    return jnp.mean(predict_leaves(forest, x), axis=1)
+
+
+def predict_votes(forest: PackedForest, x: jnp.ndarray) -> jnp.ndarray:
+    """Hard-vote count per point [n] — the reference's per-point vote sum
+    (``uncertainty_sampling.py:96``): each tree votes its majority class."""
+    return jnp.sum(predict_leaves(forest, x) > 0.5, axis=1).astype(jnp.int32)
+
+
+def predict_value(forest: PackedForest, x: jnp.ndarray) -> jnp.ndarray:
+    """Regression prediction per point [n]: mean of per-tree values (the packed
+    equivalent of the 2000-tree LAL regressor predict, ``active_learner.py:319-321``)."""
+    return jnp.mean(predict_leaves(forest, x), axis=1)
+
+
+def pad_forest(forest: PackedForest, n_nodes: int) -> PackedForest:
+    """Pad every tree's node arrays to ``n_nodes`` with self-looping leaves."""
+    T, N = forest.feature.shape
+    if N > n_nodes:
+        raise ValueError(f"forest has {N} nodes; budget {n_nodes} too small")
+    if N == n_nodes:
+        return forest
+    pad = n_nodes - N
+    idx = jnp.arange(N, n_nodes, dtype=jnp.int32)
+    return PackedForest(
+        feature=jnp.pad(forest.feature, ((0, 0), (0, pad)), constant_values=LEAF),
+        threshold=jnp.pad(forest.threshold, ((0, 0), (0, pad))),
+        left=jnp.concatenate([forest.left, jnp.broadcast_to(idx, (T, pad))], axis=1),
+        right=jnp.concatenate([forest.right, jnp.broadcast_to(idx, (T, pad))], axis=1),
+        value=jnp.pad(forest.value, ((0, 0), (0, pad))),
+        max_depth=forest.max_depth,
+    )

@@ -1,0 +1,33 @@
+"""Distribution layer: device meshes, shardings, collectives, sharded kernels.
+
+Replaces the reference's L1 Spark runtime (SURVEY.md §2.4): RDD partitions
+become mesh-axis shards of dense arrays, shuffle joins become XLA collectives
+over ICI (``psum``/``all_gather``), and the driver/executor split disappears
+into one SPMD program. Multi-host scaling goes through ``jax.distributed`` +
+the same mesh over DCN (no code change — the mesh just spans more devices).
+
+Axes:
+  ``data``  — pool rows (the reference's RDD partitioning of the unlabeled pool)
+  ``model`` — ensemble/tree axis (the reference's sequential per-tree jobs,
+              ``classes/active_learner.py:169-184``, become a sharded vmap)
+"""
+
+from distributed_active_learning_tpu.parallel.mesh import (
+    AXIS_DATA,
+    AXIS_MODEL,
+    make_mesh,
+    pool_spec,
+    forest_spec,
+    replicated_spec,
+    shard_pool_state,
+    shard_forest,
+)
+from distributed_active_learning_tpu.parallel.kernels import (
+    sharded_votes,
+    sharded_similarity_mass,
+    make_sharded_round_fn,
+)
+from distributed_active_learning_tpu.parallel.collectives import (
+    vector_accumulate,
+    masked_mean,
+)

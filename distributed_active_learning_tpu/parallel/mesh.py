@@ -1,0 +1,83 @@
+"""Mesh construction and sharding specs for AL state and packed forests."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_active_learning_tpu.ops.trees import PackedForest
+from distributed_active_learning_tpu.runtime.state import PoolState
+
+AXIS_DATA = "data"
+AXIS_MODEL = "model"
+
+
+def make_mesh(
+    data: Optional[int] = None,
+    model: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a ``(data, model)`` mesh over the available devices.
+
+    Defaults to all devices on the data axis — the shape of the problem: pools
+    are huge, forests are small (the reference likewise distributes the pool
+    and keeps trees on the driver, ``active_learner.py:169-184``).
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    if data is None:
+        if len(devs) % model:
+            raise ValueError(f"{len(devs)} devices not divisible by model={model}")
+        data = len(devs) // model
+    if data * model > len(devs):
+        raise ValueError(f"mesh {data}x{model} exceeds {len(devs)} devices")
+    grid = np.asarray(devs[: data * model]).reshape(data, model)
+    return Mesh(grid, (AXIS_DATA, AXIS_MODEL))
+
+
+def pool_spec() -> P:
+    """Pool rows sharded over data; feature dim replicated."""
+    return P(AXIS_DATA, None)
+
+
+def mask_spec() -> P:
+    return P(AXIS_DATA)
+
+
+def forest_spec() -> P:
+    """Trees sharded over the model axis; node arrays replicated per tree."""
+    return P(AXIS_MODEL, None)
+
+
+def replicated_spec() -> P:
+    return P()
+
+
+def shard_pool_state(state: PoolState, mesh: Mesh) -> PoolState:
+    """Place pool arrays with rows sharded over the data axis.
+
+    Pool sizes not divisible by the axis are handled by the caller padding the
+    pool (datasets here are padded at load when sharding is requested).
+    """
+    return PoolState(
+        x=jax.device_put(state.x, NamedSharding(mesh, pool_spec())),
+        oracle_y=jax.device_put(state.oracle_y, NamedSharding(mesh, mask_spec())),
+        labeled_mask=jax.device_put(state.labeled_mask, NamedSharding(mesh, mask_spec())),
+        key=jax.device_put(state.key, NamedSharding(mesh, replicated_spec())),
+        round=jax.device_put(state.round, NamedSharding(mesh, replicated_spec())),
+    )
+
+
+def shard_forest(forest: PackedForest, mesh: Mesh) -> PackedForest:
+    """Place the packed forest with trees sharded over the model axis."""
+    tree_sh = NamedSharding(mesh, forest_spec())
+    return PackedForest(
+        feature=jax.device_put(forest.feature, tree_sh),
+        threshold=jax.device_put(forest.threshold, tree_sh),
+        left=jax.device_put(forest.left, tree_sh),
+        right=jax.device_put(forest.right, tree_sh),
+        value=jax.device_put(forest.value, tree_sh),
+        max_depth=forest.max_depth,
+    )

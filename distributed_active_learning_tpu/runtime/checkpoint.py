@@ -1,0 +1,89 @@
+"""Checkpoint/resume of full AL-experiment state.
+
+The reference persists only *models* (``save_regression_model.py:28-34``
+try-load-else-train against HDFS; MLlib classifier save observed broken,
+``mllib_random_forest_classifer.py:55-58``) — never the AL loop state, so a
+crashed run restarts from scratch (SURVEY.md §5.4). Here a checkpoint captures
+everything needed to resume mid-experiment: the labeled mask, PRNG key, round
+counter, and the accuracy history. Pool features are NOT stored (they are
+reproducible from the dataset config); masks + key make the resumed run
+bit-identical.
+
+Format: step-numbered ``.npz`` files (portable, atomic via rename) + the
+records as JSON lines.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+import tempfile
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_active_learning_tpu.runtime.results import ExperimentResult, RoundRecord
+from distributed_active_learning_tpu.runtime.state import PoolState
+
+_STEP_RE = re.compile(r"^alstate_(\d+)\.npz$")
+
+
+def save(ckpt_dir: str, state: PoolState, result: ExperimentResult) -> str:
+    """Write a checkpoint for the state's current round; returns the path."""
+    os.makedirs(ckpt_dir, exist_ok=True)
+    step = int(state.round)
+    payload = {
+        "labeled_mask": np.asarray(state.labeled_mask),
+        "key": np.asarray(jax.random.key_data(state.key)),
+        "round": np.asarray(step, dtype=np.int32),
+        "records_json": np.frombuffer(
+            json.dumps([dataclasses.asdict(r) for r in result.records]).encode(),
+            dtype=np.uint8,
+        ),
+    }
+    final = os.path.join(ckpt_dir, f"alstate_{step}.npz")
+    fd, tmp = tempfile.mkstemp(dir=ckpt_dir, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, **payload)
+        os.replace(tmp, final)  # atomic publish
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    return final
+
+
+def latest_step(ckpt_dir: str) -> Optional[int]:
+    if not os.path.isdir(ckpt_dir):
+        return None
+    steps = [
+        int(m.group(1))
+        for fn in os.listdir(ckpt_dir)
+        if (m := _STEP_RE.match(fn))
+    ]
+    return max(steps) if steps else None
+
+
+def restore_latest(
+    ckpt_dir: str, state: PoolState, result: ExperimentResult
+) -> Optional[Tuple[PoolState, ExperimentResult]]:
+    """Load the newest checkpoint into (state, result); None if none exists."""
+    step = latest_step(ckpt_dir)
+    if step is None:
+        return None
+    with np.load(os.path.join(ckpt_dir, f"alstate_{step}.npz")) as z:
+        mask = jnp.asarray(z["labeled_mask"])
+        key = jax.random.wrap_key_data(jnp.asarray(z["key"]))
+        rnd = jnp.asarray(z["round"])
+        records = json.loads(bytes(z["records_json"]).decode())
+    if mask.shape != state.labeled_mask.shape:
+        raise ValueError(
+            f"checkpoint pool size {mask.shape} != experiment pool {state.labeled_mask.shape}"
+        )
+    new_state = state.replace(labeled_mask=mask, key=key, round=rnd)
+    new_result = ExperimentResult(records=[RoundRecord(**r) for r in records])
+    return new_state, new_result

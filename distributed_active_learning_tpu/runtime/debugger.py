@@ -1,0 +1,86 @@
+"""Structured phase timing — the reference's ``Debugger`` made useful.
+
+The reference duplicates a wall-clock tracer in both main dirs
+(``final_thesis/debugger.py:6-27``; ``classes/debugger.py:6-42``):
+``TIMESTAMP(label)`` prints a banner with per-phase elapsed and cumulative
+seconds, plus ``DEBUG(arg)`` pretty-prints of collect()ed RDDs. Results were
+captured by redirecting stdout (``classes/RESULTS.txt``).
+
+This version keeps the same phase-segmentation idea but records structured
+``(label, elapsed)`` pairs, supports nesting via context managers, and can emit
+a ``jax.profiler`` trace for real TPU profiling (SURVEY.md §5.1).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, List, Optional, Tuple
+
+
+class Debugger:
+    """Phase timer with the reference's TIMESTAMP semantics + structured records."""
+
+    def __init__(self, enabled: bool = True, printer=print):
+        self.enabled = enabled
+        self.printer = printer
+        self.records: List[Tuple[str, float]] = []
+        self._start = time.perf_counter()
+        self._last = self._start
+
+    def timestamp(self, label: str) -> float:
+        """Record elapsed time since the previous timestamp under ``label``.
+
+        Mirrors ``Debugger.TIMESTAMP`` (``final_thesis/debugger.py:15-27``):
+        per-phase elapsed + running total, then resets the phase timer.
+        """
+        now = time.perf_counter()
+        elapsed = now - self._last
+        self._last = now
+        self.records.append((label, elapsed))
+        if self.enabled:
+            total = now - self._start
+            self.printer(f"[{label}] {elapsed:.3f}s (total {total:.3f}s)")
+        return elapsed
+
+    def debug(self, *args) -> None:
+        """Pretty-print hook (``classes/debugger.py:14-22``)."""
+        if self.enabled:
+            self.printer("[DEBUG]", *args)
+
+    @contextlib.contextmanager
+    def phase(self, label: str):
+        """Nested phase timing as a context manager."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            elapsed = time.perf_counter() - t0
+            self.records.append((label, elapsed))
+            if self.enabled:
+                self.printer(f"[{label}] {elapsed:.3f}s")
+
+    def totals(self) -> Dict[str, float]:
+        """Aggregate elapsed seconds per label."""
+        out: Dict[str, float] = {}
+        for label, elapsed in self.records:
+            out[label] = out.get(label, 0.0) + elapsed
+        return out
+
+    def total_time(self) -> float:
+        return time.perf_counter() - self._start
+
+
+@contextlib.contextmanager
+def profiler_trace(log_dir: Optional[str]):
+    """Wrap a block in a ``jax.profiler`` trace when ``log_dir`` is set."""
+    if log_dir is None:
+        yield
+        return
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -1,0 +1,190 @@
+"""The AL driver loop: jitted round function + host experiment driver.
+
+Reference shape (``final_thesis/uncertainty_sampling.py:60-114``): a driver-side
+``while True`` that re-joins index RDDs to data, trains an RF in the JVM, runs
+one Spark job per tree over the pool, shuffles votes, sorts, takes the window,
+and rebuilds the pool sets — every step crossing the Py4J and executor
+boundaries.
+
+TPU shape (SURVEY.md §7): one jitted function
+``(forest, state, aux) -> (new_state, picked, scores)`` does score + select +
+mask-update entirely on device; the host loop only (a) fits the forest on the
+labeled subset (the JVM-fit equivalent), (b) calls the round function, and
+(c) logs. The only data that crosses the host boundary per round is the labeled
+subset and a scalar accuracy.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_active_learning_tpu.config import ExperimentConfig
+from distributed_active_learning_tpu.data.datasets import DataBundle, get_dataset
+from distributed_active_learning_tpu.models.forest import (
+    fit_forest_classifier,
+    fit_forest_regressor,
+)
+from distributed_active_learning_tpu.ops.topk import select_bottom_k, select_top_k
+from distributed_active_learning_tpu.ops.trees import PackedForest, predict_proba
+from distributed_active_learning_tpu.runtime import state as state_lib
+from distributed_active_learning_tpu.runtime.debugger import Debugger
+from distributed_active_learning_tpu.runtime.results import ExperimentResult, RoundRecord
+from distributed_active_learning_tpu.strategies import Strategy, StrategyAux, get_strategy
+
+
+def make_round_fn(strategy: Strategy, window_size: int):
+    """Build the jitted AL round: score pool -> masked top-k -> reveal.
+
+    Static over (strategy, window_size); all dynamic state is pytree args, so
+    successive rounds reuse one compiled executable.
+    """
+
+    @jax.jit
+    def round_fn(
+        forest: PackedForest, state: state_lib.PoolState, aux: StrategyAux
+    ) -> Tuple[state_lib.PoolState, jnp.ndarray, jnp.ndarray]:
+        key, k_score = jax.random.split(state.key)
+        state = state.replace(key=key)
+        scores = strategy.score(forest, state, k_score, aux)
+        unlabeled = ~state.labeled_mask
+        if strategy.higher_is_better:
+            _, picked = select_top_k(scores, unlabeled, window_size)
+        else:
+            _, picked = select_bottom_k(scores, unlabeled, window_size)
+        new_state = state_lib.reveal(state, picked)
+        return new_state, picked, scores
+
+    return round_fn
+
+
+@jax.jit
+def _accuracy(forest: PackedForest, test_x: jnp.ndarray, test_y: jnp.ndarray) -> jnp.ndarray:
+    """Test accuracy on device (``uncertainty_sampling.py:79-83``)."""
+    pred = (predict_proba(forest, test_x) > 0.5).astype(jnp.int32)
+    return jnp.mean((pred == test_y).astype(jnp.float32))
+
+
+def _labeled_subset(
+    state: state_lib.PoolState,
+    host_x: Optional[np.ndarray] = None,
+    host_y: Optional[np.ndarray] = None,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Host-side gather of the labeled subset for the sklearn fit.
+
+    This is the one legitimate host round-trip: the reference does the same
+    gather as a leftOuterJoin shuffle + JVM fit (``active_learner.py:65-76``).
+    Pass ``host_x``/``host_y`` (the immutable pool arrays, held host-side once)
+    so only the boolean mask crosses the device boundary per round — not the
+    full [n, d] pool.
+    """
+    mask = np.asarray(state.labeled_mask)
+    x = (host_x if host_x is not None else np.asarray(state.x))[mask]
+    y = (host_y if host_y is not None else np.asarray(state.oracle_y))[mask]
+    return x, y
+
+
+def build_aux(cfg: ExperimentConfig, state: state_lib.PoolState) -> StrategyAux:
+    """Assemble strategy aux inputs (LAL regressor, seed mask) from config."""
+    lal_forest = None
+    options = dict(cfg.strategy.options)
+    if cfg.strategy.name == "lal":
+        from distributed_active_learning_tpu.models.lal_training import (
+            load_or_train_lal_regressor,
+        )
+
+        lal_forest = load_or_train_lal_regressor(options)
+    return StrategyAux(lal_forest=lal_forest, seed_mask=state.labeled_mask)
+
+
+def run_experiment(
+    cfg: ExperimentConfig,
+    bundle: Optional[DataBundle] = None,
+    debugger: Optional[Debugger] = None,
+) -> ExperimentResult:
+    """Run a full AL experiment; returns per-round records.
+
+    Equivalent of the reference's per-strategy driver scripts
+    (``uncertainty_sampling.py`` etc.) and the experiment tail of
+    ``active_learner.py:369-384``, with the gaps the reference left filled in:
+    configurable stopping, structured timing, optional checkpoint/resume.
+    """
+    dbg = debugger or Debugger(enabled=False)
+    if bundle is None:
+        bundle = get_dataset(cfg.data)
+
+    test_x = jnp.asarray(bundle.test_x)
+    test_y = jnp.asarray(bundle.test_y)
+    # Immutable pool arrays kept host-side: per-round fits index these, so only
+    # the labeled mask crosses the device boundary each round.
+    host_x = np.ascontiguousarray(bundle.train_x, dtype=np.float32)
+    host_y = np.asarray(bundle.train_y, dtype=np.int32)
+
+    state = state_lib.init_pool_state(bundle.train_x, bundle.train_y, jax.random.key(cfg.seed))
+    state = state_lib.set_start_state(state, cfg.n_start)
+
+    strategy = get_strategy(cfg.strategy)
+    aux = build_aux(cfg, state)
+    round_fn = make_round_fn(strategy, cfg.strategy.window_size)
+
+    result = ExperimentResult()
+    start_round = int(state.round)
+
+    if cfg.checkpoint_dir and cfg.checkpoint_every:
+        from distributed_active_learning_tpu.runtime import checkpoint as ckpt_lib
+
+        restored = ckpt_lib.restore_latest(cfg.checkpoint_dir, state, result)
+        if restored is not None:
+            state, result = restored
+            start_round = int(state.round)
+            dbg.debug(f"resumed at round {start_round}")
+
+    n_pool = state.n_pool
+    round_idx = start_round
+    while True:
+        n_labeled = int(state_lib.labeled_count(state))
+        if n_labeled >= n_pool:
+            break
+        if cfg.label_budget is not None and n_labeled >= cfg.label_budget:
+            break
+        if cfg.max_rounds is not None and round_idx - start_round >= cfg.max_rounds:
+            break
+        round_idx += 1
+
+        with dbg.phase("train"):
+            lx, ly = _labeled_subset(state, host_x, host_y)
+            forest = fit_forest_classifier(lx, ly, cfg.forest, seed=cfg.seed + round_idx)
+        train_time = dbg.records[-1][1]
+
+        with dbg.phase("round"):
+            state, picked, _ = round_fn(forest, state, aux)
+            acc = float(_accuracy(forest, test_x, test_y))
+        score_time = dbg.records[-1][1]
+
+        n_labeled = int(state_lib.labeled_count(state))
+        rec = RoundRecord(
+            round=round_idx,
+            n_labeled=n_labeled,
+            n_unlabeled=n_pool - n_labeled,
+            accuracy=acc,
+            train_time=train_time,
+            score_time=score_time,
+            total_time=train_time + score_time,
+        )
+        result.append(rec)
+        if cfg.log_every and round_idx % cfg.log_every == 0:
+            dbg.debug(
+                f"Iteration {round_idx} -- labeled={n_labeled} accu={acc * 100:.2f}"
+            )
+        if cfg.checkpoint_dir and cfg.checkpoint_every and round_idx % cfg.checkpoint_every == 0:
+            from distributed_active_learning_tpu.runtime import checkpoint as ckpt_lib
+
+            ckpt_lib.save(cfg.checkpoint_dir, state, result)
+
+    if cfg.results_path:
+        result.save(cfg.results_path, fmt="reference")
+    return result

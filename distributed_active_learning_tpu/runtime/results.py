@@ -1,0 +1,83 @@
+"""Per-round experiment records + reference-format results logs.
+
+The reference's observability is print-only: per-round labeled/unlabeled counts
+and accuracy (``uncertainty_sampling.py:65,113``) redirected into
+``final_thesis/results/*.txt``. This module writes the same line format (so
+curve-comparison tooling works on both) while also keeping structured records
+for programmatic analysis and checkpointing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import List, Optional
+
+
+@dataclasses.dataclass
+class RoundRecord:
+    round: int
+    n_labeled: int
+    n_unlabeled: int
+    accuracy: float
+    train_time: float = 0.0
+    score_time: float = 0.0
+    total_time: float = 0.0
+
+
+@dataclasses.dataclass
+class ExperimentResult:
+    records: List[RoundRecord] = dataclasses.field(default_factory=list)
+
+    def append(self, rec: RoundRecord) -> None:
+        self.records.append(rec)
+
+    @property
+    def final_accuracy(self) -> Optional[float]:
+        return self.records[-1].accuracy if self.records else None
+
+    def accuracy_curve(self):
+        return [(r.n_labeled, r.accuracy) for r in self.records]
+
+    def to_reference_log(self) -> str:
+        """Render in the exact format of ``final_thesis/results/*.txt``::
+
+            labeled =  10  unlabeled =  9990
+            Iteration  1  -- accu =  85.05
+        """
+        lines = []
+        for r in self.records:
+            lines.append(f"labeled =  {r.n_labeled}  unlabeled =  {r.n_unlabeled}")
+            lines.append(f"Iteration  {r.round}  -- accu =  {r.accuracy * 100:.2f}")
+        return "\n".join(lines) + "\n"
+
+    def to_jsonl(self) -> str:
+        return "\n".join(json.dumps(dataclasses.asdict(r)) for r in self.records) + "\n"
+
+    def save(self, path: str, fmt: str = "reference") -> None:
+        text = self.to_reference_log() if fmt == "reference" else self.to_jsonl()
+        with open(path, "w") as f:
+            f.write(text)
+
+
+def parse_reference_log(text: str) -> ExperimentResult:
+    """Parse a reference-format results log back into records (for golden-curve
+    regression tests against ``final_thesis/results/*.txt`` numbers)."""
+    result = ExperimentResult()
+    n_labeled = n_unlabeled = None
+    for line in text.splitlines():
+        parts = line.split()
+        if line.startswith("labeled"):
+            # "labeled =  10  unlabeled =  9990"
+            n_labeled, n_unlabeled = int(parts[2]), int(parts[5])
+        elif line.startswith("Iteration") and n_labeled is not None:
+            # "Iteration  1  -- accu =  85.05"
+            result.append(
+                RoundRecord(
+                    round=int(parts[1]),
+                    n_labeled=n_labeled,
+                    n_unlabeled=n_unlabeled,
+                    accuracy=float(parts[-1]) / 100.0,
+                )
+            )
+    return result

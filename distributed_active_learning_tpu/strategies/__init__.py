@@ -1,0 +1,29 @@
+"""Query-strategy registry.
+
+The reference implements strategies twice — flat per-file ``while True`` loops
+(``final_thesis/random_sampling.py:61-94``, ``uncertainty_sampling.py:60-114``,
+``density_weighting.py:109-179``) and an OOP hierarchy with ``selectNext()``
+(``classes/active_learner.py:34-343``). Here both collapse into one registry of
+pure scoring functions consumed by the jitted round function; batch ("window")
+and single-point modes are the same code with ``window_size`` 10/50/100 vs 1.
+"""
+
+from distributed_active_learning_tpu.strategies.base import (
+    Strategy,
+    StrategyAux,
+    get_strategy,
+    register_strategy,
+    available_strategies,
+)
+
+# Import for registration side effects.
+from distributed_active_learning_tpu.strategies import core as _core  # noqa: F401
+from distributed_active_learning_tpu.strategies import lal as _lal  # noqa: F401
+
+__all__ = [
+    "Strategy",
+    "StrategyAux",
+    "get_strategy",
+    "register_strategy",
+    "available_strategies",
+]

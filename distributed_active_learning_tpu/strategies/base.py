@@ -1,0 +1,67 @@
+"""Strategy protocol: a pure scoring function plus selection direction."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from distributed_active_learning_tpu.config import StrategyConfig
+from distributed_active_learning_tpu.ops.trees import PackedForest
+from distributed_active_learning_tpu.runtime.state import PoolState
+
+
+@struct.dataclass
+class StrategyAux:
+    """Optional per-round auxiliary inputs a strategy may need.
+
+    A pytree (so it can cross the jit boundary as an argument).
+
+    ``lal_forest``: the pretrained LAL regressor (``active_learner.py:319-321``).
+    ``seed_mask``: the initially-labeled seed mask, for reference-exact density
+    masking (``density_weighting.py:95-100``).
+    """
+
+    lal_forest: Optional[PackedForest] = None
+    seed_mask: Optional[jnp.ndarray] = None
+
+
+# A scoring function: (forest, state, key, aux) -> scores [n_pool].
+ScoreFn = Callable[[PackedForest, PoolState, jax.Array, StrategyAux], jnp.ndarray]
+
+
+@dataclasses.dataclass(frozen=True)
+class Strategy:
+    """A named scoring rule.
+
+    ``higher_is_better`` decides whether selection takes the top-k (descending,
+    e.g. density heuristic at ``density_weighting.py:168``) or bottom-k
+    (ascending, e.g. uncertainty distance at ``uncertainty_sampling.py:106``).
+    """
+
+    name: str
+    score: ScoreFn
+    higher_is_better: bool = True
+
+
+_REGISTRY: Dict[str, Callable[[StrategyConfig], Strategy]] = {}
+
+
+def register_strategy(name: str):
+    def deco(builder: Callable[[StrategyConfig], Strategy]):
+        _REGISTRY[name] = builder
+        return builder
+    return deco
+
+
+def available_strategies():
+    return sorted(_REGISTRY)
+
+
+def get_strategy(cfg: StrategyConfig) -> Strategy:
+    if cfg.name not in _REGISTRY:
+        raise KeyError(f"unknown strategy {cfg.name!r}; available: {available_strategies()}")
+    return _REGISTRY[cfg.name](cfg)

@@ -1,0 +1,122 @@
+"""Core strategies: random, uncertainty, entropy, margin, density-weighted.
+
+Each mirrors a reference strategy's scoring rule exactly (citations inline);
+all are pure functions over device arrays, so one jitted round evaluates any of
+them with zero host round-trips.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from distributed_active_learning_tpu.config import StrategyConfig
+from distributed_active_learning_tpu.ops import scoring, similarity
+from distributed_active_learning_tpu.ops.trees import PackedForest, predict_votes
+from distributed_active_learning_tpu.runtime.state import PoolState
+from distributed_active_learning_tpu.strategies.base import (
+    Strategy,
+    StrategyAux,
+    register_strategy,
+)
+
+
+def _vote_fraction(forest: PackedForest, state: PoolState) -> jnp.ndarray:
+    """Positive-vote fraction per pool point — the probability estimate every
+    reference strategy derives from the per-tree vote sum
+    (``uncertainty_sampling.py:96-98``: votes from hard per-tree predictions)."""
+    votes = predict_votes(forest, state.x)
+    return votes.astype(jnp.float32) / forest.n_trees
+
+
+@register_strategy("random")
+def _random(cfg: StrategyConfig) -> Strategy:
+    """Uniform-random selection — the control baseline.
+
+    The reference shuffles the unlabeled index RDD by a random sort key and
+    takes the window (``random_sampling.py:88-89``; ``active_learner.py:133-136``).
+    A random priority per point + top-k is the same distribution.
+    """
+
+    def score(forest, state, key, aux):
+        del forest, aux
+        return jax.random.uniform(key, (state.n_pool,))
+
+    return Strategy(name="random", score=score, higher_is_better=True)
+
+
+@register_strategy("uncertainty")
+def _uncertainty(cfg: StrategyConfig) -> Strategy:
+    """Least-confidence: distance of the vote fraction from 0.5, ascending
+    (``uncertainty_sampling.py:98,106``; ``active_learner.py:197,203``)."""
+
+    def score(forest, state, key, aux):
+        del key, aux
+        return scoring.uncertainty_score(_vote_fraction(forest, state))
+
+    return Strategy(name="uncertainty", score=score, higher_is_better=False)
+
+
+@register_strategy("entropy")
+def _entropy(cfg: StrategyConfig) -> Strategy:
+    """The reference's one-sided entropy ``-(1-p)·log2(1-p)``
+    (``density_weighting.py:148``), descending."""
+
+    def score(forest, state, key, aux):
+        del key, aux
+        return scoring.positive_entropy(_vote_fraction(forest, state))
+
+    return Strategy(name="entropy", score=score, higher_is_better=True)
+
+
+@register_strategy("full_entropy")
+def _full_entropy(cfg: StrategyConfig) -> Strategy:
+    """Standard binary entropy (the correct form the reference approximates)."""
+
+    def score(forest, state, key, aux):
+        del key, aux
+        return scoring.full_entropy(_vote_fraction(forest, state))
+
+    return Strategy(name="full_entropy", score=score, higher_is_better=True)
+
+
+@register_strategy("margin")
+def _margin(cfg: StrategyConfig) -> Strategy:
+    """Top-2 margin, ascending. Standard AL companion (not in the reference)."""
+
+    def score(forest, state, key, aux):
+        del key, aux
+        return scoring.margin_score(_vote_fraction(forest, state))
+
+    return Strategy(name="margin", score=score, higher_is_better=False)
+
+
+@register_strategy("density")
+def _density(cfg: StrategyConfig) -> Strategy:
+    """Information density: one-sided entropy x (similarity mass ** beta),
+    descending (``density_weighting.py:148-168``; beta at ``:33``).
+
+    Similarity mass is computed in O(n·d) via the matvec identity (see
+    ``ops/similarity.similarity_mass``) instead of the reference's O(n²·d)
+    BlockMatrix build + n²-entry shuffle. By default mass counts the *current*
+    unlabeled set; set ``options={'mass_over': 'non_seed'}`` (with
+    ``aux.seed_mask``) to reproduce the reference's seeds-only exclusion
+    (``density_weighting.py:95-100``).
+    """
+    mass_over = dict(cfg.options).get("mass_over", "unlabeled")
+    beta = cfg.beta
+
+    def score(forest, state, key, aux):
+        del key
+        ent = scoring.positive_entropy(_vote_fraction(forest, state))
+        if mass_over == "non_seed" and aux.seed_mask is not None:
+            count_mask = ~aux.seed_mask
+        else:
+            count_mask = ~state.labeled_mask
+        mass = similarity.similarity_mass(state.x, count_mask)
+        # mass can be slightly negative for adversarial embeddings; clamp so
+        # the beta power is defined.
+        mass = jnp.maximum(mass, 0.0)
+        return ent * jnp.power(mass, beta)
+
+    return Strategy(name="density", score=score, higher_is_better=True)

@@ -1,18 +1,26 @@
-"""Test harness: force an 8-device virtual CPU mesh before JAX initializes.
+"""Test harness: force an 8-device virtual CPU mesh.
 
 The reference never simulated its cluster (local-mode master only exists as
 commented-out code, ``classes/dataset.py:16-17``); here every multi-device code
 path is exercised on CPU via XLA's virtual host devices (SURVEY.md §4).
+
+Note: this environment pre-imports jax via a sitecustomize on PYTHONPATH (the
+TPU tunnel), so env-var routes (``JAX_PLATFORMS``/``XLA_FLAGS``) are too late
+by conftest time. ``jax.config.update`` still works before first backend use.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Best effort for subprocesses spawned by tests.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,127 @@
+"""Packed forest kernel vs the sklearn oracle (SURVEY.md §4: the test strategy
+the reference lacked — deterministic unit tests against single-node oracles)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from sklearn.ensemble import RandomForestClassifier, RandomForestRegressor
+
+from distributed_active_learning_tpu.config import ForestConfig
+from distributed_active_learning_tpu.models.forest import (
+    fit_forest_classifier,
+    fit_forest_regressor,
+    pack_sklearn_forest,
+    forest_accuracy,
+)
+from distributed_active_learning_tpu.ops.trees import (
+    PackedForest,
+    predict_leaves,
+    predict_proba,
+    predict_votes,
+    predict_value,
+    pad_forest,
+)
+
+
+@pytest.fixture(scope="module")
+def blobs():
+    rng = np.random.default_rng(0)
+    n = 600
+    x = rng.normal(size=(n, 5)).astype(np.float32)
+    y = (x[:, 0] + 0.5 * x[:, 1] - 0.2 * x[:, 2] > 0).astype(np.int32)
+    return x, y
+
+
+def test_packed_proba_matches_sklearn(blobs):
+    x, y = blobs
+    model = RandomForestClassifier(n_estimators=12, max_depth=6, random_state=0)
+    model.fit(x, y)
+    packed = pack_sklearn_forest(model)
+    ours = np.asarray(predict_proba(packed, jnp.asarray(x)))
+    oracle = model.predict_proba(x)[:, list(model.classes_).index(1)]
+    np.testing.assert_allclose(ours, oracle, atol=1e-5)
+
+
+def test_packed_regressor_matches_sklearn(blobs):
+    x, _ = blobs
+    target = (x[:, 0] * 2.0 + np.sin(x[:, 1])).astype(np.float32)
+    model = RandomForestRegressor(n_estimators=8, max_depth=6, random_state=1)
+    model.fit(x, target)
+    packed = pack_sklearn_forest(model)
+    ours = np.asarray(predict_value(packed, jnp.asarray(x)))
+    np.testing.assert_allclose(ours, model.predict(x), atol=1e-4)
+
+
+def test_votes_match_per_tree_hard_predictions(blobs):
+    x, y = blobs
+    model = RandomForestClassifier(n_estimators=10, max_depth=4, random_state=2)
+    model.fit(x, y)
+    packed = pack_sklearn_forest(model)
+    votes = np.asarray(predict_votes(packed, jnp.asarray(x)))
+    # oracle: the reference's semantics — sum of per-tree majority votes
+    # (uncertainty_sampling.py:88-96), one tree at a time.
+    per_tree = np.stack([
+        est.predict_proba(x)[:, list(model.classes_).index(1)] > 0.5
+        for est in model.estimators_
+    ])
+    np.testing.assert_array_equal(votes, per_tree.sum(axis=0))
+
+
+def test_leaves_shape_and_jit(blobs):
+    x, y = blobs
+    cfg = ForestConfig(n_trees=5, max_depth=3)
+    packed = fit_forest_classifier(x, y, cfg)
+    assert packed.n_trees == 5
+    assert packed.n_nodes == cfg.resolved_node_budget  # padded to budget: static shapes
+    leaves = jax.jit(predict_leaves)(packed, jnp.asarray(x[:32]))
+    assert leaves.shape == (32, 5)
+
+
+def test_node_budget_keeps_shapes_static(blobs):
+    """Different labeled subsets must produce identically-shaped forests
+    (no recompiles across AL rounds)."""
+    x, y = blobs
+    cfg = ForestConfig(n_trees=4, max_depth=4)
+    f1 = fit_forest_classifier(x[:50], y[:50], cfg)
+    f2 = fit_forest_classifier(x[:400], y[:400], cfg)
+    assert f1.feature.shape == f2.feature.shape
+    assert f1.max_depth == f2.max_depth
+
+
+def test_pad_forest_self_loops(blobs):
+    x, y = blobs
+    model = RandomForestClassifier(n_estimators=3, max_depth=3, random_state=0)
+    model.fit(x, y)
+    packed = pack_sklearn_forest(model)
+    padded = pad_forest(packed, packed.n_nodes + 10)
+    np.testing.assert_allclose(
+        np.asarray(predict_proba(padded, jnp.asarray(x[:64]))),
+        np.asarray(predict_proba(packed, jnp.asarray(x[:64]))),
+    )
+
+
+def test_single_class_labeled_set(blobs):
+    """Early AL rounds can fit on a single-class subset; proba must be constant."""
+    x, _ = blobs
+    y = np.ones(len(x), dtype=np.int32)
+    cfg = ForestConfig(n_trees=3, max_depth=2)
+    packed = fit_forest_classifier(x[:20], y[:20], cfg)
+    probs = np.asarray(predict_proba(packed, jnp.asarray(x[:10])))
+    np.testing.assert_allclose(probs, 1.0)
+
+
+def test_forest_accuracy_eval(blobs):
+    x, y = blobs
+    cfg = ForestConfig(n_trees=20, max_depth=8)
+    packed = fit_forest_classifier(x, y, cfg)
+    acc = forest_accuracy(packed, x, y)
+    assert acc > 0.95  # in-sample on a separable problem
+
+
+def test_deep_tree_budget_guard(blobs):
+    x, y = blobs
+    model = RandomForestClassifier(n_estimators=2, max_depth=8, random_state=0)
+    model.fit(x, y)
+    with pytest.raises(ValueError, match="budget"):
+        pack_sklearn_forest(model, node_budget=3)

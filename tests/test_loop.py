@@ -1,0 +1,130 @@
+"""End-to-end AL experiments: the reference's experiment-level regression test
+(AL must beat random at equal label budget, SURVEY.md §4 item 3), results
+format, checkpoint/resume."""
+
+import os
+
+import numpy as np
+import jax
+import pytest
+
+from distributed_active_learning_tpu.config import (
+    DataConfig,
+    ExperimentConfig,
+    ForestConfig,
+    StrategyConfig,
+)
+from distributed_active_learning_tpu.runtime.loop import run_experiment
+from distributed_active_learning_tpu.runtime.results import (
+    ExperimentResult,
+    RoundRecord,
+    parse_reference_log,
+)
+
+
+def _cfg(strategy="uncertainty", **kw):
+    return ExperimentConfig(
+        data=DataConfig(name="checkerboard2x2", seed=3),
+        forest=ForestConfig(n_trees=10, max_depth=4),
+        strategy=StrategyConfig(name=strategy, window_size=20),
+        n_start=10,
+        max_rounds=kw.pop("max_rounds", 8),
+        seed=kw.pop("seed", 0),
+        **kw,
+    )
+
+
+def test_run_experiment_produces_monotone_labeled_counts():
+    res = run_experiment(_cfg(max_rounds=4))
+    assert len(res.records) == 4
+    counts = [r.n_labeled for r in res.records]
+    assert counts == sorted(counts)
+    assert counts[0] == 30  # 10 start + 20 window
+    assert all(0.0 <= r.accuracy <= 1.0 for r in res.records)
+
+
+def test_uncertainty_curve_beats_random_on_checkerboard():
+    """The reference's headline claim (results/striatum_*: distUS > distRAND at
+    equal budget). Averaged over seeds on checkerboard4x4 to damp noise."""
+    accs = {"uncertainty": [], "random": []}
+    for seed in (0, 1, 2):
+        for name in accs:
+            cfg = ExperimentConfig(
+                data=DataConfig(name="checkerboard4x4", seed=5),
+                forest=ForestConfig(n_trees=10, max_depth=6),
+                strategy=StrategyConfig(name=name, window_size=30),
+                n_start=10,
+                max_rounds=6,
+                seed=seed,
+            )
+            accs[name].append(run_experiment(cfg).final_accuracy)
+    assert np.mean(accs["uncertainty"]) >= np.mean(accs["random"]) - 0.02, accs
+
+
+def test_label_budget_stops_loop():
+    res = run_experiment(_cfg(label_budget=50, max_rounds=100))
+    assert res.records[-1].n_labeled >= 50
+    assert res.records[-1].n_labeled <= 70  # one window overshoot max
+
+
+def test_results_reference_format_roundtrip(tmp_path):
+    res = ExperimentResult(
+        records=[
+            RoundRecord(round=1, n_labeled=10, n_unlabeled=990, accuracy=0.8505),
+            RoundRecord(round=2, n_labeled=20, n_unlabeled=980, accuracy=0.8619),
+        ]
+    )
+    text = res.to_reference_log()
+    assert "labeled =  10  unlabeled =  990" in text
+    assert "Iteration  1  -- accu =  85.05" in text
+    back = parse_reference_log(text)
+    assert [(r.n_labeled, round(r.accuracy, 4)) for r in back.records] == [
+        (10, 0.8505),
+        (20, 0.8619),
+    ]
+
+
+def test_results_path_written(tmp_path):
+    out = os.path.join(tmp_path, "run.txt")
+    run_experiment(_cfg(max_rounds=2, results_path=out))
+    text = open(out).read()
+    assert text.startswith("labeled =")
+
+
+def test_checkpoint_resume_bit_identical(tmp_path):
+    """Crash-resume parity: full run vs interrupted+resumed run give identical
+    labeled masks and curves (the gap called out in SURVEY.md §5.4)."""
+    ckpt = os.path.join(tmp_path, "ckpt")
+    full = run_experiment(_cfg(max_rounds=6, seed=4))
+
+    partial = run_experiment(
+        _cfg(max_rounds=3, seed=4, checkpoint_dir=ckpt, checkpoint_every=1)
+    )
+    assert len(partial.records) == 3
+    resumed = run_experiment(
+        _cfg(max_rounds=3, seed=4, checkpoint_dir=ckpt, checkpoint_every=1)
+    )
+    # resumed continues rounds 4-6
+    all_records = resumed.records
+    assert [r.round for r in all_records] == [1, 2, 3, 4, 5, 6]
+    np.testing.assert_allclose(
+        [r.n_labeled for r in all_records], [r.n_labeled for r in full.records]
+    )
+    np.testing.assert_allclose(
+        [r.accuracy for r in all_records], [r.accuracy for r in full.records], atol=1e-6
+    )
+
+
+def test_checkpoint_pool_size_mismatch_raises(tmp_path):
+    ckpt = os.path.join(tmp_path, "ckpt")
+    run_experiment(_cfg(max_rounds=1, checkpoint_dir=ckpt, checkpoint_every=1))
+    bad = ExperimentConfig(
+        data=DataConfig(name="checkerboard2x2", n_samples=500, seed=3),
+        strategy=StrategyConfig(name="uncertainty", window_size=5),
+        n_start=4,
+        max_rounds=1,
+        checkpoint_dir=ckpt,
+        checkpoint_every=1,
+    )
+    with pytest.raises(ValueError, match="pool size"):
+        run_experiment(bad)

@@ -1,0 +1,110 @@
+"""Scoring, selection, and similarity kernels vs numpy oracles."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from distributed_active_learning_tpu.ops import (
+    uncertainty_score,
+    positive_entropy,
+    full_entropy,
+    margin_score,
+    vote_sd,
+    select_top_k,
+    select_bottom_k,
+)
+from distributed_active_learning_tpu.ops.similarity import (
+    l2_normalize,
+    pairwise_cosine,
+    similarity_mass,
+    blocked_pairwise_cosine_reduce,
+)
+
+
+def test_uncertainty_score_reference_formula():
+    p = jnp.asarray([0.0, 0.3, 0.5, 0.8, 1.0])
+    # abs(0.5 - (1 - p)) per uncertainty_sampling.py:98
+    np.testing.assert_allclose(
+        np.asarray(uncertainty_score(p)), np.abs(0.5 - (1 - np.asarray(p))), atol=1e-7
+    )
+
+
+def test_positive_entropy_matches_reference_formula():
+    p = jnp.asarray([0.1, 0.5, 0.9])
+    q = 1 - np.asarray(p)
+    np.testing.assert_allclose(
+        np.asarray(positive_entropy(p)), -q * np.log2(q), atol=1e-4
+    )
+
+
+def test_positive_entropy_finite_at_p1():
+    assert np.isfinite(float(positive_entropy(jnp.asarray(1.0))))
+
+
+def test_full_entropy_symmetric_max_at_half():
+    e = np.asarray(full_entropy(jnp.asarray([0.25, 0.5, 0.75])))
+    assert e[1] > e[0] and abs(e[0] - e[2]) < 1e-6 and abs(e[1] - 1.0) < 1e-6
+
+
+def test_margin_and_vote_sd():
+    np.testing.assert_allclose(np.asarray(margin_score(jnp.asarray([0.5, 1.0]))), [0.0, 1.0])
+    np.testing.assert_allclose(
+        np.asarray(vote_sd(jnp.asarray([5.0, 0.0, 10.0]), 10)),
+        [0.5, 0.0, 0.0],
+        atol=1e-7,
+    )
+
+
+def test_select_top_k_never_picks_labeled():
+    scores = jnp.asarray([10.0, 9.0, 8.0, 7.0, 1.0])
+    unlabeled = jnp.asarray([False, False, True, True, True])
+    _, idx = select_top_k(scores, unlabeled, 2)
+    assert set(np.asarray(idx).tolist()) == {2, 3}
+
+
+def test_select_bottom_k_ascending():
+    scores = jnp.asarray([0.1, 0.01, 0.5, 0.02, 0.4])
+    unlabeled = jnp.asarray([True, False, True, True, True])
+    vals, idx = select_bottom_k(scores, unlabeled, 2)
+    assert list(np.asarray(idx)) == [3, 0]  # 0.01 is labeled -> excluded
+    np.testing.assert_allclose(np.asarray(vals), [0.02, 0.1], atol=1e-7)
+
+
+def test_select_with_window_larger_than_unlabeled():
+    scores = jnp.asarray([1.0, 2.0, 3.0])
+    unlabeled = jnp.asarray([False, False, True])
+    _, idx = select_top_k(scores, unlabeled, 3)
+    # first pick is the only unlabeled point; extras land on labeled (-inf)
+    assert int(idx[0]) == 2
+
+
+def test_pairwise_cosine_vs_numpy(key):
+    x = np.asarray(jax.random.normal(key, (50, 8)))
+    ours = np.asarray(pairwise_cosine(jnp.asarray(x)))
+    xn = x / np.linalg.norm(x, axis=1, keepdims=True)
+    np.testing.assert_allclose(ours, xn @ xn.T, atol=1e-5)
+
+
+def test_similarity_mass_matvec_equals_matrix_rowsum(key):
+    """The O(nd) matvec identity vs the explicit O(n^2) masked row-sum."""
+    x = np.asarray(jax.random.normal(key, (64, 5)))
+    mask = np.asarray(jax.random.uniform(jax.random.key(7), (64,)) > 0.4)
+    ours = np.asarray(similarity_mass(jnp.asarray(x), jnp.asarray(mask)))
+    xn = x / np.linalg.norm(x, axis=1, keepdims=True)
+    S = xn @ xn.T
+    oracle = (S * mask[None, :]).sum(axis=1)
+    np.testing.assert_allclose(ours, oracle, atol=1e-4)
+
+
+def test_blocked_reduce_matches_full(key):
+    x = np.asarray(jax.random.normal(key, (100, 6)))
+    out = np.asarray(
+        blocked_pairwise_cosine_reduce(jnp.asarray(x), lambda s: jnp.sum(s, axis=1), block=32)
+    )
+    xn = x / np.linalg.norm(x, axis=1, keepdims=True)
+    np.testing.assert_allclose(out, (xn @ xn.T).sum(axis=1), atol=1e-4)
+
+
+def test_l2_normalize_zero_row_safe():
+    x = jnp.zeros((3, 4))
+    assert np.all(np.isfinite(np.asarray(l2_normalize(x))))

@@ -1,0 +1,111 @@
+"""Multi-device sharding: shard_map kernels vs single-device oracles, and the
+full sharded AL round on a (data x model) mesh — all on the 8-device CPU mesh."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distributed_active_learning_tpu.config import ForestConfig, StrategyConfig
+from distributed_active_learning_tpu.data.synthetic import make_checkerboard
+from distributed_active_learning_tpu.models.forest import fit_forest_classifier
+from distributed_active_learning_tpu.ops.similarity import similarity_mass
+from distributed_active_learning_tpu.ops.trees import predict_votes
+from distributed_active_learning_tpu.parallel import (
+    make_mesh,
+    shard_forest,
+    shard_pool_state,
+    sharded_similarity_mass,
+    sharded_votes,
+    make_sharded_round_fn,
+)
+from distributed_active_learning_tpu.runtime.state import (
+    init_pool_state,
+    labeled_count,
+    set_start_state,
+)
+from distributed_active_learning_tpu.strategies import StrategyAux, get_strategy
+
+
+@pytest.fixture(scope="module")
+def setup(request):
+    x, y = make_checkerboard(jax.random.key(0), 256)
+    state = set_start_state(init_pool_state(x, y, jax.random.key(1)), 8)
+    lx = np.asarray(state.x)[np.asarray(state.labeled_mask)]
+    ly = np.asarray(state.oracle_y)[np.asarray(state.labeled_mask)]
+    forest = fit_forest_classifier(lx, ly, ForestConfig(n_trees=8, max_depth=4))
+    return forest, state
+
+
+def test_make_mesh_shapes(devices):
+    mesh = make_mesh(data=4, model=2)
+    assert mesh.shape == {"data": 4, "model": 2}
+    mesh_all = make_mesh()
+    assert mesh_all.shape["data"] == 8
+
+
+def test_make_mesh_validation(devices):
+    with pytest.raises(ValueError, match="not divisible"):
+        make_mesh(model=3)
+    with pytest.raises(ValueError, match="exceeds"):
+        make_mesh(data=16, model=1)
+
+
+def test_sharded_votes_matches_single_device(devices, setup):
+    forest, state = setup
+    mesh = make_mesh(data=4, model=2)
+    sv = jax.jit(sharded_votes(mesh))
+    x_sh = jax.device_put(state.x, NamedSharding(mesh, P("data", None)))
+    got = np.asarray(sv(shard_forest(forest, mesh), x_sh))
+    want = np.asarray(predict_votes(forest, state.x))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_sharded_mass_matches_single_device(devices, setup):
+    _, state = setup
+    mesh = make_mesh(data=8, model=1)
+    sm = jax.jit(sharded_similarity_mass(mesh))
+    got = np.asarray(sm(state.x, ~state.labeled_mask))
+    want = np.asarray(similarity_mass(state.x, ~state.labeled_mask))
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+@pytest.mark.parametrize("name", ["uncertainty", "density", "random"])
+def test_sharded_round_matches_unsharded(devices, setup, name):
+    """The GSPMD round over a 4x2 mesh must pick the same points as the
+    single-device round (same PRNG, same scores)."""
+    forest, state = setup
+    strat = get_strategy(StrategyConfig(name=name, window_size=6))
+    from distributed_active_learning_tpu.runtime.loop import make_round_fn
+
+    single = make_round_fn(strat, 6)
+    aux = StrategyAux(seed_mask=state.labeled_mask)
+    s_new, s_picked, s_scores = single(forest, state, aux)
+
+    mesh = make_mesh(data=4, model=2)
+    sharded = make_sharded_round_fn(strat, 6, mesh)
+    st_sh = shard_pool_state(state, mesh)
+    f_sh = shard_forest(forest, mesh)
+    aux_sh = StrategyAux(seed_mask=st_sh.labeled_mask)
+    m_new, m_picked, m_scores = sharded(f_sh, st_sh, aux_sh)
+
+    np.testing.assert_allclose(np.asarray(s_scores), np.asarray(m_scores), atol=1e-4)
+    assert set(np.asarray(s_picked).tolist()) == set(np.asarray(m_picked).tolist())
+    np.testing.assert_array_equal(
+        np.asarray(s_new.labeled_mask), np.asarray(m_new.labeled_mask)
+    )
+
+
+def test_sharded_round_output_stays_sharded(devices, setup):
+    forest, state = setup
+    strat = get_strategy(StrategyConfig(name="uncertainty", window_size=4))
+    mesh = make_mesh(data=8, model=1)
+    sharded = make_sharded_round_fn(strat, 4, mesh)
+    st_sh = shard_pool_state(state, mesh)
+    f_sh = shard_forest(forest, mesh)
+    new_state, _, _ = sharded(f_sh, st_sh, StrategyAux())
+    assert int(labeled_count(new_state)) == int(labeled_count(state)) + 4
+    # mask must not have collapsed to a single device
+    sh = new_state.labeled_mask.sharding
+    assert not sh.is_fully_replicated

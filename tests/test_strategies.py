@@ -1,0 +1,132 @@
+"""Strategy registry: semantics, invariants, jit-ability."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distributed_active_learning_tpu.config import ForestConfig, StrategyConfig
+from distributed_active_learning_tpu.data.synthetic import make_checkerboard
+from distributed_active_learning_tpu.models.forest import fit_forest_classifier
+from distributed_active_learning_tpu.runtime.loop import make_round_fn
+from distributed_active_learning_tpu.runtime.state import (
+    init_pool_state,
+    labeled_count,
+    set_start_state,
+)
+from distributed_active_learning_tpu.strategies import (
+    StrategyAux,
+    available_strategies,
+    get_strategy,
+)
+from distributed_active_learning_tpu.strategies.lal import lal_features
+
+
+@pytest.fixture(scope="module")
+def setup():
+    kx = jax.random.key(0)
+    x, y = make_checkerboard(kx, 300)
+    state = set_start_state(init_pool_state(x, y, jax.random.key(1)), 10)
+    lx = np.asarray(state.x)[np.asarray(state.labeled_mask)]
+    ly = np.asarray(state.oracle_y)[np.asarray(state.labeled_mask)]
+    forest = fit_forest_classifier(lx, ly, ForestConfig(n_trees=8, max_depth=4))
+    return forest, state
+
+
+def test_registry_contents():
+    names = available_strategies()
+    assert {"random", "uncertainty", "entropy", "full_entropy", "margin", "density", "lal"} <= set(names)
+
+
+def test_unknown_strategy_raises():
+    with pytest.raises(KeyError, match="unknown strategy"):
+        get_strategy(StrategyConfig(name="bogus"))
+
+
+@pytest.mark.parametrize("name", ["random", "uncertainty", "entropy", "full_entropy", "margin", "density"])
+def test_round_never_picks_labeled(setup, name):
+    forest, state = setup
+    strat = get_strategy(StrategyConfig(name=name, window_size=7))
+    round_fn = make_round_fn(strat, 7)
+    aux = StrategyAux(seed_mask=state.labeled_mask)
+    before = np.asarray(state.labeled_mask).copy()
+    new_state, picked, scores = round_fn(forest, state, aux)
+    picked = np.asarray(picked)
+    assert not before[picked].any(), f"{name} picked already-labeled points"
+    assert int(labeled_count(new_state)) == int(labeled_count(state)) + 7
+    assert np.asarray(scores).shape == (state.n_pool,)
+
+
+def test_uncertainty_picks_closest_to_boundary(setup):
+    forest, state = setup
+    strat = get_strategy(StrategyConfig(name="uncertainty", window_size=5))
+    aux = StrategyAux()
+    scores = strat.score(forest, state, jax.random.key(0), aux)
+    round_fn = make_round_fn(strat, 5)
+    _, picked, _ = round_fn(forest, state, aux)
+    unlab = np.asarray(~state.labeled_mask)
+    s = np.asarray(scores)
+    best = np.sort(s[unlab])[:5]
+    np.testing.assert_allclose(np.sort(s[np.asarray(picked)]), best, atol=1e-6)
+
+
+def test_random_strategy_varies_with_key(setup):
+    forest, state = setup
+    strat = get_strategy(StrategyConfig(name="random"))
+    aux = StrategyAux()
+    s1 = strat.score(forest, state, jax.random.key(1), aux)
+    s2 = strat.score(forest, state, jax.random.key(2), aux)
+    assert not np.allclose(np.asarray(s1), np.asarray(s2))
+
+
+def test_density_is_entropy_times_mass(setup):
+    forest, state = setup
+    aux = StrategyAux(seed_mask=state.labeled_mask)
+    strat = get_strategy(StrategyConfig(name="density", beta=1.0))
+    from distributed_active_learning_tpu.ops.scoring import positive_entropy
+    from distributed_active_learning_tpu.ops.similarity import similarity_mass
+    from distributed_active_learning_tpu.ops.trees import predict_votes
+
+    scores = np.asarray(strat.score(forest, state, jax.random.key(0), aux))
+    p = np.asarray(predict_votes(forest, state.x)) / forest.n_trees
+    ent = np.asarray(positive_entropy(jnp.asarray(p)))
+    mass = np.asarray(similarity_mass(state.x, ~state.labeled_mask))
+    np.testing.assert_allclose(scores, ent * np.maximum(mass, 0), rtol=1e-4)
+
+
+def test_lal_features_shape_and_scalars(setup):
+    forest, state = setup
+    feats = np.asarray(lal_features(forest, state))
+    assert feats.shape == (state.n_pool, 5)
+    # f3/f6/f8 are pool-level scalars broadcast per point (active_learner.py:286-296)
+    for col in (2, 3, 4):
+        assert np.allclose(feats[:, col], feats[0, col])
+    assert feats[0, 4] == int(labeled_count(state))  # f8 = nLabeled
+    # f1 in [0,1], f2 in [0,0.5]
+    assert feats[:, 0].min() >= 0 and feats[:, 0].max() <= 1
+    assert feats[:, 1].max() <= 0.5 + 1e-6
+
+
+def test_lal_strategy_requires_regressor(setup):
+    forest, state = setup
+    strat = get_strategy(StrategyConfig(name="lal"))
+    with pytest.raises(ValueError, match="lal_forest"):
+        strat.score(forest, state, jax.random.key(0), StrategyAux())
+
+
+def test_lal_end_to_end_with_tiny_regressor(setup):
+    forest, state = setup
+    from distributed_active_learning_tpu.models.lal_training import (
+        generate_lal_dataset,
+        train_lal_regressor,
+    )
+
+    feats, targets = generate_lal_dataset(seed=0, n_experiments=4, candidates_per_experiment=3, pool_size=60)
+    assert feats.shape[1] == 5 and len(targets) == len(feats)
+    reg = train_lal_regressor(feats, targets, n_trees=10, max_depth=4)
+    strat = get_strategy(StrategyConfig(name="lal", window_size=3))
+    aux = StrategyAux(lal_forest=reg, seed_mask=state.labeled_mask)
+    round_fn = make_round_fn(strat, 3)
+    new_state, picked, scores = round_fn(forest, state, aux)
+    assert not np.asarray(state.labeled_mask)[np.asarray(picked)].any()
+    assert np.isfinite(np.asarray(scores)).all()
