@@ -1,0 +1,64 @@
+"""All-pairs cosine-similarity benchmark.
+
+Parity workload for the reference's standalone similarity probes:
+``final_thesis/cosine_similarity.py:26-46`` (BlockMatrix S = U·Uᵀ over a
+3000x500 random matrix), ``similarity.py:37-38`` (DIMSUM), ``test.py:29-38``
+(CoordinateMatrix path). One JSON line per shape.
+
+Usage: python benches/similarity_bench.py [--shapes 3000x500,50000x1000]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--shapes", default="3000x500")
+    ap.add_argument("--iters", type=int, default=5)
+    ap.add_argument("--mass-only", action="store_true",
+                    help="benchmark the O(n*d) mass kernel instead of the full matrix")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_active_learning_tpu.ops.similarity import (
+        pairwise_cosine,
+        similarity_mass,
+    )
+
+    for shape in args.shapes.split(","):
+        n, d = (int(v) for v in shape.split("x"))
+        x = jnp.asarray(np.random.default_rng(0).normal(size=(n, d)).astype(np.float32))
+        mask = jnp.ones(n, dtype=bool)
+
+        if args.mass_only:
+            fn = jax.jit(lambda a: similarity_mass(a, mask))
+        else:
+            fn = jax.jit(pairwise_cosine)
+        out = jax.block_until_ready(fn(x))
+        times = []
+        for _ in range(args.iters):
+            t0 = time.perf_counter()
+            out = jax.block_until_ready(fn(x))
+            times.append(time.perf_counter() - t0)
+        best = min(times)
+        entries_per_sec = (n * n if not args.mass_only else n) / best
+        print(json.dumps({
+            "metric": "similarity_mass_rows_per_sec" if args.mass_only else "similarity_entries_per_sec",
+            "shape": shape,
+            "seconds": round(best, 5),
+            "value": round(entries_per_sec, 1),
+        }))
+
+
+if __name__ == "__main__":
+    main()

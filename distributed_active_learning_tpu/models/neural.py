@@ -1,0 +1,191 @@
+"""Neural base learners for deep active learning.
+
+The reference has no neural models — its stretch configs (BASELINE.json 4-5:
+CIFAR-10 small CNN with entropy/density acquisition, AG-News BERT with
+BatchBALD) introduce them. This module provides the TPU-native ``ProbModel``
+protocol those configs need: fully-jitted training on the labeled subset
+(masked sampling, no dynamic shapes) and Monte-Carlo predictive distributions
+(MC-dropout) for information-theoretic acquisition.
+
+Design notes (TPU-first):
+- Training never materializes the labeled subset: minibatches are drawn on
+  device by sampling indices from the labeled-mask categorical, so the jitted
+  train step has static shapes regardless of how many points are labeled.
+- ``lax.scan`` over steps inside one jit => one compilation per experiment.
+- Predictions batch the pool through the network in fixed-size chunks; MC
+  samples ride a leading vmapped axis.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import linen as nn
+
+
+class SmallCNN(nn.Module):
+    """Compact conv net for CIFAR-shaped inputs (BASELINE.json config 4).
+
+    Conv-BN-free (batch statistics interact badly with tiny AL labeled sets);
+    dropout doubles as the MC posterior for BALD/BatchBALD.
+    """
+
+    n_classes: int = 10
+    dropout_rate: float = 0.25
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        for feats in (32, 64):
+            x = nn.Conv(feats, (3, 3))(x)
+            x = nn.relu(x)
+            x = nn.Conv(feats, (3, 3))(x)
+            x = nn.relu(x)
+            x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+            x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(128)(x)
+        x = nn.relu(x)
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        return nn.Dense(self.n_classes)(x)
+
+
+class MLP(nn.Module):
+    """Small MLP for tabular pools (drop-in neural learner for the striatum/
+    fraud-format datasets)."""
+
+    n_classes: int = 2
+    hidden: Tuple[int, ...] = (128, 64)
+    dropout_rate: float = 0.2
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        for h in self.hidden:
+            x = nn.Dense(h)(x)
+            x = nn.relu(x)
+            x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        return nn.Dense(self.n_classes)(x)
+
+
+class TrainState(NamedTuple):
+    params: Any
+    opt_state: Any
+    step: jnp.ndarray
+
+
+class NeuralLearner:
+    """Jitted trainer + MC predictor around a flax module.
+
+    ``fit_on_mask`` is the neural counterpart of the per-round RF fit: it
+    (re)trains on the labeled subset selected by a boolean mask, entirely on
+    device.
+    """
+
+    def __init__(
+        self,
+        module: nn.Module,
+        input_shape: Tuple[int, ...],
+        learning_rate: float = 1e-3,
+        batch_size: int = 64,
+        train_steps: int = 200,
+        mc_samples: int = 8,
+        predict_chunk: int = 4096,
+    ):
+        self.module = module
+        self.input_shape = tuple(input_shape)
+        self.batch_size = batch_size
+        self.train_steps = train_steps
+        self.mc_samples = mc_samples
+        self.predict_chunk = predict_chunk
+        self.tx = optax.adam(learning_rate)
+
+    def init(self, key: jax.Array) -> TrainState:
+        params = self.module.init(
+            {"params": key}, jnp.zeros((1, *self.input_shape)), train=False
+        )["params"]
+        return TrainState(params=params, opt_state=self.tx.init(params), step=jnp.asarray(0))
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def fit_on_mask(
+        self,
+        state: TrainState,
+        x: jnp.ndarray,
+        y: jnp.ndarray,
+        labeled_mask: jnp.ndarray,
+        key: jax.Array,
+    ) -> TrainState:
+        """Train ``train_steps`` minibatch steps on the masked labeled subset.
+
+        Batches are index-samples from the labeled set (with replacement) via a
+        masked categorical — static shapes for any labeled count.
+        """
+        logits_mask = jnp.where(labeled_mask, 0.0, -jnp.inf)
+
+        def step(carry, key):
+            state = carry
+            k_idx, k_drop = jax.random.split(key)
+            idx = jax.random.categorical(
+                k_idx, jnp.broadcast_to(logits_mask, (self.batch_size, x.shape[0]))
+            )
+            xb, yb = x[idx], y[idx]
+
+            def loss_fn(params):
+                logits = self.module.apply(
+                    {"params": params}, xb, train=True, rngs={"dropout": k_drop}
+                )
+                return optax.softmax_cross_entropy_with_integer_labels(logits, yb).mean()
+
+            grads = jax.grad(loss_fn)(state.params)
+            updates, opt_state = self.tx.update(grads, state.opt_state, state.params)
+            params = optax.apply_updates(state.params, updates)
+            return TrainState(params, opt_state, state.step + 1), None
+
+        keys = jax.random.split(key, self.train_steps)
+        state, _ = jax.lax.scan(step, state, keys)
+        return state
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def predict_proba(self, state: TrainState, x: jnp.ndarray) -> jnp.ndarray:
+        """Deterministic class probabilities ``[n, C]`` (dropout off)."""
+        def chunk_apply(xc):
+            return nn.softmax(self.module.apply({"params": state.params}, xc, train=False))
+
+        return _chunked(chunk_apply, x, self.predict_chunk)
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def predict_proba_samples(
+        self, state: TrainState, x: jnp.ndarray, key: jax.Array
+    ) -> jnp.ndarray:
+        """MC-dropout predictive samples ``[S, n, C]`` — the posterior draws
+        BALD/BatchBALD consume."""
+        keys = jax.random.split(key, self.mc_samples)
+
+        def one_sample(k):
+            def chunk_apply(xc):
+                return nn.softmax(
+                    self.module.apply(
+                        {"params": state.params}, xc, train=True, rngs={"dropout": k}
+                    )
+                )
+
+            return _chunked(chunk_apply, x, self.predict_chunk)
+
+        return jax.vmap(one_sample)(keys)
+
+    def accuracy(self, state: TrainState, x: jnp.ndarray, y: jnp.ndarray) -> float:
+        probs = self.predict_proba(state, x)
+        return float(jnp.mean((jnp.argmax(probs, -1) == y).astype(jnp.float32)))
+
+
+def _chunked(fn: Callable, x: jnp.ndarray, chunk: int) -> jnp.ndarray:
+    """Apply ``fn`` over fixed-size row chunks (pads the tail; static shapes)."""
+    n = x.shape[0]
+    if n <= chunk:
+        return fn(x)
+    pad = (-n) % chunk
+    xp = jnp.pad(x, ((0, pad),) + ((0, 0),) * (x.ndim - 1))
+    out = jax.lax.map(fn, xp.reshape(-1, chunk, *x.shape[1:]))
+    return out.reshape(-1, *out.shape[2:])[:n]

@@ -1,0 +1,92 @@
+"""Transformer text encoder for the AG-News-style deep-AL config.
+
+BASELINE.json config 5 pairs a BERT-style encoder with BatchBALD acquisition.
+This is a compact flax encoder whose attention primitive is injectable: the
+default is single-device :func:`ops.ring_attention.full_attention`; pass a
+``mesh`` to shard the sequence axis through :func:`ops.ring_attention.ring_attention`
+for long-context pools. Dropout doubles as the MC posterior so the module plugs
+straight into :class:`models.neural.NeuralLearner` and the deep strategies.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from distributed_active_learning_tpu.ops.ring_attention import full_attention
+
+
+class MultiHeadAttention(nn.Module):
+    n_heads: int
+    d_model: int
+    attention_fn: Callable = staticmethod(full_attention)
+
+    @nn.compact
+    def __call__(self, x):
+        B, T, _ = x.shape
+        Dh = self.d_model // self.n_heads
+        qkv = nn.Dense(3 * self.d_model, use_bias=False)(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, T, self.n_heads, Dh)
+        k = k.reshape(B, T, self.n_heads, Dh)
+        v = v.reshape(B, T, self.n_heads, Dh)
+        out = self.attention_fn(q, k, v)
+        return nn.Dense(self.d_model)(out.reshape(B, T, self.d_model))
+
+
+class EncoderBlock(nn.Module):
+    n_heads: int
+    d_model: int
+    d_ff: int
+    dropout_rate: float
+    attention_fn: Callable = staticmethod(full_attention)
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h = nn.LayerNorm()(x)
+        h = MultiHeadAttention(self.n_heads, self.d_model, attention_fn=self.attention_fn)(h)
+        h = nn.Dropout(self.dropout_rate, deterministic=not train)(h)
+        x = x + h
+        h = nn.LayerNorm()(x)
+        h = nn.Dense(self.d_ff)(h)
+        h = nn.gelu(h)
+        h = nn.Dense(self.d_model)(h)
+        h = nn.Dropout(self.dropout_rate, deterministic=not train)(h)
+        return x + h
+
+
+class TransformerClassifier(nn.Module):
+    """Token-id input ``[B, T] int32`` -> class logits ``[B, C]``."""
+
+    vocab_size: int = 30522
+    max_len: int = 128
+    d_model: int = 128
+    n_heads: int = 4
+    n_layers: int = 2
+    d_ff: int = 256
+    n_classes: int = 4  # AG-News
+    dropout_rate: float = 0.1
+    attention_fn: Callable = staticmethod(full_attention)
+
+    @nn.compact
+    def __call__(self, ids, train: bool = False):
+        ids = ids.astype(jnp.int32)
+        T = ids.shape[1]
+        if T > self.max_len:
+            # XLA's clamp-mode gather would silently give every position past
+            # max_len the same embedding; fail loudly instead.
+            raise ValueError(f"sequence length {T} exceeds max_len={self.max_len}")
+        x = nn.Embed(self.vocab_size, self.d_model)(ids)
+        pos = nn.Embed(self.max_len, self.d_model)(jnp.arange(T)[None, :])
+        x = x + pos
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        for _ in range(self.n_layers):
+            x = EncoderBlock(
+                self.n_heads, self.d_model, self.d_ff, self.dropout_rate,
+                attention_fn=self.attention_fn,
+            )(x, train=train)
+        x = nn.LayerNorm()(x)
+        pooled = x.mean(axis=1)
+        return nn.Dense(self.n_classes)(pooled)

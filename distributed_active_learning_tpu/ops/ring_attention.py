@@ -1,0 +1,129 @@
+"""Ring attention: sequence-parallel exact attention via ppermute.
+
+Long-context support for the text-encoder AL path (BASELINE.json config 5).
+The sequence axis is sharded over a mesh axis; each device computes attention
+of its local query block against a K/V block that circulates around the ring
+(one ``lax.ppermute`` per step), merging partial results with an online-softmax
+accumulator. Exact (not approximate) attention with O(seq/devices) activation
+memory per device and all communication riding ICI neighbor links.
+
+The reference has nothing comparable (no sequence models at all, SURVEY.md
+§5.7); this is the capability that lets the framework scale the "big dimension"
+of text pools the way the reference chunked its similarity matrix over
+BlockMatrix partitions.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+SEQ_AXIS = "sp"
+
+
+def _online_softmax_step(o, l, m, scores, v_cur):
+    """Merge one block's scores/values into the running (o, l, m) accumulator.
+
+    o: [B, H, Tq, D] weighted-value accumulator (unnormalized)
+    l: [B, H, Tq]    running normalizer
+    m: [B, H, Tq]    running max logit
+    scores: [B, H, Tq, Tk]; v_cur: [B, Tk, H, D]
+    """
+    m_blk = jnp.max(scores, axis=-1)
+    m_new = jnp.maximum(m, m_blk)
+    # rescale old accumulator, accumulate this block
+    alpha = jnp.exp(m - m_new)
+    p = jnp.exp(scores - m_new[..., None])  # [B, H, Tq, Tk]
+    o = o * alpha[..., None] + jnp.einsum("bhqk,bkhd->bhqd", p, v_cur)
+    l = l * alpha + jnp.sum(p, axis=-1)
+    return o, l, m_new
+
+
+def _ring_attention_sharded(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str,
+    causal: bool,
+    scale: float,
+) -> jnp.ndarray:
+    """Per-shard kernel. q/k/v: [B, T_blk, H, D] (local block)."""
+    n_dev = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    B, T, H, D = q.shape
+
+    neg = jnp.asarray(-1e30, dtype=q.dtype)
+    q_pos = my * T + jnp.arange(T)  # global positions of local queries
+
+    # accumulators derive a zero from q so they inherit q's varying-axis type
+    # under shard_map (fresh constants would fail the fori_loop carry check)
+    zero_bht = jnp.transpose(q[:, :, :, 0], (0, 2, 1)) * 0  # [B, H, T]
+    o0 = jnp.zeros((B, H, T, D), dtype=q.dtype) + zero_bht[..., None]
+    l0 = zero_bht
+    m0 = zero_bht - jnp.inf
+
+    def body(i, carry):
+        o, l, m, k_cur, v_cur = carry
+        # the block currently held originated on device (my - i) mod n_dev
+        src = lax.rem(my - i + n_dev, n_dev)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_cur) * scale
+        if causal:
+            k_pos = src * T + jnp.arange(T)
+            mask = q_pos[:, None] >= k_pos[None, :]  # [Tq, Tk]
+            scores = jnp.where(mask[None, None], scores, neg)
+        o, l, m = _online_softmax_step(o, l, m, scores, v_cur)
+        # circulate K/V to the right neighbor
+        perm = [(j, (j + 1) % n_dev) for j in range(n_dev)]
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return o, l, m, k_nxt, v_nxt
+
+    o, l, m, _, _ = lax.fori_loop(0, n_dev, body, (o0, l0, m0, k, v))
+    out = o / jnp.maximum(l[..., None], 1e-30)
+    return jnp.transpose(out, (0, 2, 1, 3))  # [B, T, H, D]
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mesh: Mesh,
+    axis_name: str = SEQ_AXIS,
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Exact attention with the sequence dim sharded over ``axis_name``.
+
+    q/k/v: [B, T, H, D] with T sharded; returns [B, T, H, D], same sharding.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    spec = P(None, axis_name, None, None)
+    kernel = functools.partial(
+        _ring_attention_sharded, axis_name=axis_name, causal=causal, scale=scale
+    )
+    return jax.shard_map(
+        kernel, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+    )(q, k, v)
+
+
+def full_attention(
+    q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, causal: bool = False,
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Single-device reference attention (the oracle for ring_attention and the
+    fast path when the sequence fits one chip). Same [B, T, H, D] layout."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        T = q.shape[1]
+        mask = jnp.tril(jnp.ones((T, T), dtype=bool))
+        scores = jnp.where(mask[None, None], scores, -1e30)
+    w = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", w, v)

@@ -1,0 +1,161 @@
+"""Command-line experiment driver.
+
+The reference is launched as ``spark-submit uncertainty_sampling.py`` with all
+parameters hardcoded per file (SURVEY.md §5.6); this CLI is the replacement:
+
+    python -m distributed_active_learning_tpu.run \
+        --dataset checkerboard4x4 --strategy uncertainty --window 10 \
+        --rounds 40 --out results/distUS_w10.txt
+
+``--strategy random`` reproduces the control arm (``random_sampling.py``),
+``--strategy density`` the information-density run (``density_weighting.py``),
+``--strategy lal`` the LAL learner (``classes/active_learner.py``); results are
+written in the reference's log format for curve-for-curve comparison.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from distributed_active_learning_tpu.config import (
+    DataConfig,
+    ExperimentConfig,
+    ForestConfig,
+    StrategyConfig,
+)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="distributed_active_learning_tpu.run",
+        description="TPU-native pool-based active learning",
+    )
+    ap.add_argument("--dataset", default="checkerboard2x2")
+    ap.add_argument("--data-path", default=None, help="path for file-backed datasets")
+    ap.add_argument("--n-samples", type=int, default=None, help="subsample the pool")
+    ap.add_argument("--strategy", default="uncertainty")
+    ap.add_argument("--window", type=int, default=10)
+    ap.add_argument("--beta", type=float, default=1.0)
+    ap.add_argument("--trees", type=int, default=10)
+    ap.add_argument("--depth", type=int, default=4)
+    ap.add_argument("--n-start", type=int, default=10)
+    ap.add_argument("--rounds", type=int, default=None)
+    ap.add_argument("--budget", type=int, default=None, help="stop at N labeled")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", default=None, help="write reference-format results log")
+    ap.add_argument("--checkpoint-dir", default=None)
+    ap.add_argument("--checkpoint-every", type=int, default=0)
+    ap.add_argument("--quiet", action="store_true")
+    ap.add_argument("--json", action="store_true", help="print per-round records as JSON lines")
+    ap.add_argument("--list", action="store_true", help="list datasets and strategies")
+    # Neural (deep-AL) mode: an MLP learner over the tabular pool with MC-dropout
+    # acquisition. Selected automatically when --strategy names a deep strategy.
+    ap.add_argument("--neural", action="store_true", help="force the neural-learner path")
+    ap.add_argument("--train-steps", type=int, default=200)
+    ap.add_argument("--mc-samples", type=int, default=8)
+    ap.add_argument("--hidden", default="128,64", help="MLP hidden sizes (neural mode)")
+    return ap
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.list:
+        from distributed_active_learning_tpu.data import available_datasets
+        from distributed_active_learning_tpu.runtime.neural_loop import (
+            available_deep_strategies,
+        )
+        from distributed_active_learning_tpu.strategies import available_strategies
+
+        print("datasets:", ", ".join(available_datasets()))
+        print("strategies:", ", ".join(available_strategies()))
+        print("deep strategies:", ", ".join(available_deep_strategies()))
+        return 0
+
+    from distributed_active_learning_tpu.runtime.debugger import Debugger
+    from distributed_active_learning_tpu.runtime.loop import run_experiment
+    from distributed_active_learning_tpu.runtime.neural_loop import _SCORES as _DEEP
+
+    dbg = Debugger(enabled=not args.quiet)
+    deep_names = set(_DEEP) | {"batchbald"}
+    if args.neural or args.strategy in deep_names:
+        result = _run_neural(args, dbg)
+        _emit(args, result, dbg)
+        return 0
+
+    cfg = ExperimentConfig(
+        data=DataConfig(
+            name=args.dataset,
+            path=args.data_path,
+            n_samples=args.n_samples,
+            seed=args.seed,
+        ),
+        forest=ForestConfig(n_trees=args.trees, max_depth=args.depth),
+        strategy=StrategyConfig(name=args.strategy, window_size=args.window, beta=args.beta),
+        n_start=args.n_start,
+        max_rounds=args.rounds,
+        label_budget=args.budget,
+        seed=args.seed,
+        results_path=None,  # _emit handles --out for both loop kinds
+        checkpoint_dir=args.checkpoint_dir,
+        checkpoint_every=args.checkpoint_every,
+    )
+    result = run_experiment(cfg, debugger=dbg)
+    _emit(args, result, dbg)
+    return 0
+
+
+def _run_neural(args, dbg):
+    """Deep-AL CLI path: MLP + MC-dropout over a (flattened) registry dataset."""
+    from distributed_active_learning_tpu.data import get_dataset
+    from distributed_active_learning_tpu.models.neural import MLP, NeuralLearner
+    from distributed_active_learning_tpu.runtime.neural_loop import (
+        NeuralExperimentConfig,
+        run_neural_experiment,
+    )
+
+    bundle = get_dataset(
+        DataConfig(name=args.dataset, path=args.data_path, n_samples=args.n_samples, seed=args.seed)
+    )
+    n_classes = int(bundle.train_y.max()) + 1
+    hidden = tuple(int(h) for h in args.hidden.split(",") if h)
+    learner = NeuralLearner(
+        MLP(n_classes=max(n_classes, 2), hidden=hidden),
+        (bundle.n_features,),
+        train_steps=args.train_steps,
+        mc_samples=args.mc_samples,
+    )
+    cfg = NeuralExperimentConfig(
+        strategy=args.strategy if args.strategy != "uncertainty" else "bald",
+        window_size=args.window,
+        n_start=args.n_start,
+        max_rounds=args.rounds,
+        label_budget=args.budget,
+        seed=args.seed,
+    )
+    return run_neural_experiment(
+        cfg, learner, bundle.train_x, bundle.train_y, bundle.test_x, bundle.test_y,
+        debugger=dbg,
+    )
+
+
+def _emit(args, result, dbg):
+    if args.json:
+        sys.stdout.write(result.to_jsonl())
+    else:
+        sys.stdout.write(result.to_reference_log())
+    if args.out:
+        result.save(args.out, fmt="reference")
+    if result.final_accuracy is not None and not args.quiet:
+        print(
+            f"# final: {result.records[-1].n_labeled} labeled, "
+            f"accuracy {result.final_accuracy * 100:.2f}%, "
+            f"total {dbg.total_time():.1f}s",
+            file=sys.stderr,
+        )
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

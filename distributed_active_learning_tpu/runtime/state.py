@@ -68,42 +68,46 @@ def init_pool_state(x, y, key: jax.Array) -> PoolState:
     )
 
 
-def set_start_state(state: PoolState, n_start: int) -> PoolState:
-    """Seed the labeled set: one point of each class plus ``n_start - 2`` extras.
+def set_start_state(state: PoolState, n_start: int, n_classes: int = 2) -> PoolState:
+    """Seed the labeled set: one point per (present) class plus random extras.
 
     Functional equivalent of ``Dataset.setStartState``
     (``classes/dataset.py:56-130``): the reference shuffles the class-1 and
     class-0 index RDDs by random keys and takes one of each (``:90-106``), then
     shuffles the remainder and adds ``nStart - 2`` more (``:110-124``); the rest
-    become ``indicesUnknown`` (``:128-130``). Here the same selection is a pair
-    of masked argmaxes over random priorities plus a top-(n_start-2) over the
-    remainder — one jittable function, no shuffles.
+    become ``indicesUnknown`` (``:128-130``). Here the same selection is a
+    masked argmax over random priorities per class plus a top-k over the
+    remainder — one jittable function, no shuffles. ``n_classes > 2``
+    generalizes the guarantee to multiclass pools (CIFAR/AG-News configs);
+    classes absent from the pool are skipped (AG-News labels start at 1).
     """
     n = state.n_pool
     if n_start > n:
         raise ValueError(f"n_start={n_start} exceeds pool size {n}")
-    # The class-seed step always labels one point per class, so the effective
-    # minimum is 2 (the reference behaves identically: dataset.py:90-106).
+    present = [True] * n_classes
     if not isinstance(state.oracle_y, jax.core.Tracer):
         y = np.asarray(state.oracle_y)
-        if not ((y == 1).any() and (y == 0).any()):
+        present = [(y == c).any() for c in range(n_classes)]
+        if sum(present) < 2:
             raise ValueError(
-                "set_start_state needs at least one point of each class in the "
-                "pool (the reference's take(1) on an empty class RDD would fail "
-                "the same way: dataset.py:90-106)"
+                "set_start_state needs at least two classes present in the "
+                "pool (the reference's take(1) on an empty class RDD would "
+                "fail the same way: dataset.py:90-106)"
             )
-    key, k_pos, k_neg, k_rest = jax.random.split(state.key, 4)
+    keys = jax.random.split(state.key, n_classes + 2)
+    key, k_rest, k_classes = keys[0], keys[1], keys[2:]
 
-    pri_pos = jax.random.uniform(k_pos, (n,))
-    pri_neg = jax.random.uniform(k_neg, (n,))
-    pos_mask = state.oracle_y == 1
-    neg_mask = state.oracle_y == 0
-    pos_pick = jnp.argmax(jnp.where(pos_mask, pri_pos, -1.0))
-    neg_pick = jnp.argmax(jnp.where(neg_mask, pri_neg, -1.0))
+    mask = jnp.zeros(n, dtype=bool)
+    n_seeded = 0
+    for c in range(n_classes):
+        if not present[c]:
+            continue
+        pri = jax.random.uniform(k_classes[c], (n,))
+        pick = jnp.argmax(jnp.where(state.oracle_y == c, pri, -1.0))
+        mask = mask.at[pick].set(True)
+        n_seeded += 1
 
-    mask = jnp.zeros(n, dtype=bool).at[pos_pick].set(True).at[neg_pick].set(True)
-
-    n_extra = max(n_start - 2, 0)
+    n_extra = max(n_start - n_seeded, 0)
     if n_extra > 0:
         pri_rest = jax.random.uniform(k_rest, (n,))
         _, extra_idx = jax.lax.top_k(jnp.where(mask, -1.0, pri_rest), n_extra)

@@ -1,0 +1,127 @@
+"""Deep-AL acquisition functions over MC predictive samples.
+
+These serve the neural configs (BASELINE.json 4-5: CIFAR CNN entropy/density,
+AG-News BERT BatchBALD) — the reference itself has no neural models, so these
+are capability extensions following the standard definitions:
+
+- predictive entropy  H[E_s p]
+- BALD                H[E_s p] - E_s H[p]  (mutual information I(y; w))
+- BatchBALD           I(y_1..y_k; w) maximized greedily with an exact joint
+                      over sampled posteriors (Kirsch et al. 2019), tracked as
+                      a [S, configs] tensor while configs <= max_configs, then
+                      falling back to BALD for any remaining picks
+- mean-std            mean over classes of std over posterior samples
+- variation ratios    1 - max_c E_s p
+
+All are pure functions of ``probs_samples [S, n, C]`` and jit-friendly except
+the BatchBALD greedy loop, whose trip count ``k`` is static per window size.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+_EPS = 1e-12
+
+
+def predictive_entropy(probs_samples: jnp.ndarray) -> jnp.ndarray:
+    """H of the posterior-mean predictive, per point [n] (nats)."""
+    mean = jnp.mean(probs_samples, axis=0)
+    return -jnp.sum(mean * jnp.log(mean + _EPS), axis=-1)
+
+
+def expected_conditional_entropy(probs_samples: jnp.ndarray) -> jnp.ndarray:
+    """E_s H[p_s], per point [n] (nats)."""
+    ent = -jnp.sum(probs_samples * jnp.log(probs_samples + _EPS), axis=-1)  # [S, n]
+    return jnp.mean(ent, axis=0)
+
+
+def bald_score(probs_samples: jnp.ndarray) -> jnp.ndarray:
+    """Mutual information between label and parameters, per point [n]."""
+    return predictive_entropy(probs_samples) - expected_conditional_entropy(probs_samples)
+
+
+def mean_std_score(probs_samples: jnp.ndarray) -> jnp.ndarray:
+    """Mean over classes of the per-class posterior std, per point [n]."""
+    return jnp.mean(jnp.std(probs_samples, axis=0), axis=-1)
+
+
+def variation_ratio(probs_samples: jnp.ndarray) -> jnp.ndarray:
+    """1 - max-class probability of the posterior mean, per point [n]."""
+    return 1.0 - jnp.max(jnp.mean(probs_samples, axis=0), axis=-1)
+
+
+def _joint_entropy_candidates(joint: jnp.ndarray, probs: jnp.ndarray) -> jnp.ndarray:
+    """H of the joint (chosen-batch, candidate i) for every candidate.
+
+    ``joint [S, J]``: per posterior sample, probability of each of the J class
+    configurations of the already-chosen batch. ``probs [S, n, C]``. Returns
+    ``[n]`` joint entropies of the extended batch.
+    """
+    S = joint.shape[0]
+    q = jnp.einsum("sj,sic->ijc", joint, probs) / S  # [n, J, C]
+    return -jnp.sum(q * jnp.log(q + _EPS), axis=(1, 2))
+
+
+def batchbald_select(
+    probs_samples: jnp.ndarray,
+    unlabeled_mask: jnp.ndarray,
+    k: int,
+    max_configs: int = 4096,
+    candidate_pool: int = 512,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Greedy BatchBALD batch of ``k`` points.
+
+    Memory plan: the greedy joint is evaluated only over the top
+    ``candidate_pool`` unlabeled points by marginal BALD (standard practice —
+    BatchBALD's own experiments subsample candidates), bounding the per-pick
+    intermediate to ``candidate_pool * max_configs`` floats instead of
+    ``n_pool * max_configs``. The joint over MC posterior samples is exact
+    while the config count C^chosen stays within ``max_configs`` (binary
+    problems: window 12 at the default cap); further picks use marginal BALD —
+    documented fallback, no silent wrong answers.
+
+    Returns ``(picked_idx [k], scores_at_pick [k])`` as pool-level indices.
+    """
+    S, n, C = probs_samples.shape
+    bald = bald_score(probs_samples)
+
+    # Candidate restriction by marginal BALD (labeled points excluded).
+    m = min(candidate_pool, n)
+    if m < k:
+        m = min(n, k)
+    _, cand = jax.lax.top_k(jnp.where(unlabeled_mask, bald, -jnp.inf), m)  # [m]
+    cand_probs = probs_samples[:, cand, :]  # [S, m, C]
+    cond_ent = expected_conditional_entropy(cand_probs)  # [m]
+    cand_bald = bald[cand]
+    cand_valid = unlabeled_mask[cand]  # top_k tail may hit labeled -inf entries
+
+    joint = jnp.ones((S, 1), dtype=probs_samples.dtype)
+    chosen_mask = ~cand_valid  # within-candidate excluded set
+    picked = []
+    scores = []
+    sum_cond = jnp.asarray(0.0, dtype=probs_samples.dtype)
+    exact = True
+
+    for _ in range(k):
+        if exact and joint.shape[1] * C <= max_configs:
+            h_joint = _joint_entropy_candidates(joint, cand_probs)  # [m]
+            score = h_joint - (sum_cond + cond_ent)
+        else:
+            exact = False
+            score = cand_bald
+        score = jnp.where(chosen_mask, -jnp.inf, score)
+        j = jnp.argmax(score)
+        picked.append(cand[j])
+        scores.append(score[j])
+        chosen_mask = chosen_mask.at[j].set(True)
+        sum_cond = sum_cond + cond_ent[j]
+        if exact:
+            # extend the joint with the picked point's class axis
+            p_j = cand_probs[:, j, :]  # [S, C]
+            joint = (joint[:, :, None] * p_j[:, None, :]).reshape(S, -1)
+
+    return jnp.stack(picked), jnp.stack(scores)

@@ -1,0 +1,54 @@
+"""CLI driver: flags, output formats, list mode."""
+
+import json
+
+import pytest
+
+from distributed_active_learning_tpu.run import main
+
+
+def test_cli_list(capsys):
+    assert main(["--list"]) == 0
+    out = capsys.readouterr().out
+    assert "checkerboard2x2" in out and "uncertainty" in out and "batchbald" in out
+
+
+def test_cli_runs_experiment(capsys, tmp_path):
+    out_file = tmp_path / "res.txt"
+    rc = main([
+        "--dataset", "checkerboard2x2", "--strategy", "random", "--window", "25",
+        "--rounds", "2", "--quiet", "--out", str(out_file),
+    ])
+    assert rc == 0
+    stdout = capsys.readouterr().out
+    assert stdout.startswith("labeled =")
+    assert out_file.read_text() == stdout
+
+
+def test_cli_json_records(capsys):
+    rc = main([
+        "--dataset", "checkerboard2x2", "--strategy", "uncertainty", "--window", "30",
+        "--rounds", "2", "--quiet", "--json",
+    ])
+    assert rc == 0
+    lines = [json.loads(l) for l in capsys.readouterr().out.strip().splitlines()]
+    assert len(lines) == 2
+    assert lines[0]["n_labeled"] == 40  # 10 start + 30
+
+
+def test_cli_unknown_dataset():
+    with pytest.raises(KeyError):
+        main(["--dataset", "nope", "--rounds", "1", "--quiet"])
+
+
+def test_cli_neural_strategy_dispatch(capsys):
+    """--strategy bald routes to the neural loop (the --list entries must be runnable)."""
+    rc = main([
+        "--dataset", "checkerboard2x2", "--strategy", "bald", "--window", "10",
+        "--rounds", "2", "--quiet", "--json", "--train-steps", "30",
+        "--mc-samples", "3", "--hidden", "16",
+    ])
+    assert rc == 0
+    import json as _json
+    lines = [_json.loads(l) for l in capsys.readouterr().out.strip().splitlines()]
+    assert len(lines) == 2 and lines[-1]["n_labeled"] == 30

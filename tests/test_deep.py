@@ -1,0 +1,133 @@
+"""Deep-AL: acquisition math vs numpy oracles, neural learner training, and the
+end-to-end neural loop (CNN on synthetic CIFAR-shaped data; MLP on tabular)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distributed_active_learning_tpu.models.neural import MLP, NeuralLearner, SmallCNN
+from distributed_active_learning_tpu.runtime.neural_loop import (
+    NeuralExperimentConfig,
+    available_deep_strategies,
+    run_neural_experiment,
+)
+from distributed_active_learning_tpu.strategies import deep
+
+
+def _rand_probs(key, s=6, n=40, c=3):
+    logits = jax.random.normal(key, (s, n, c)) * 2
+    return jax.nn.softmax(logits, axis=-1)
+
+
+def test_entropy_and_bald_vs_numpy(key):
+    p = np.asarray(_rand_probs(key))
+    mean = p.mean(0)
+    ent = -(mean * np.log(mean + 1e-12)).sum(-1)
+    cond = (-(p * np.log(p + 1e-12)).sum(-1)).mean(0)
+    np.testing.assert_allclose(np.asarray(deep.predictive_entropy(jnp.asarray(p))), ent, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(deep.bald_score(jnp.asarray(p))), ent - cond, atol=1e-5)
+
+
+def test_bald_zero_when_posterior_collapsed(key):
+    one = _rand_probs(key, s=1)
+    p = jnp.tile(one, (5, 1, 1))  # identical samples -> no mutual information
+    np.testing.assert_allclose(np.asarray(deep.bald_score(p)), 0.0, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(deep.mean_std_score(p)), 0.0, atol=1e-7)
+
+
+def test_batchbald_first_pick_is_bald_argmax(key):
+    p = _rand_probs(key)
+    unlabeled = jnp.ones(p.shape[1], dtype=bool)
+    picked, _ = deep.batchbald_select(p, unlabeled, k=3)
+    assert int(picked[0]) == int(jnp.argmax(deep.bald_score(p)))
+    assert len(set(np.asarray(picked).tolist())) == 3  # no repeats
+
+
+def test_batchbald_respects_mask(key):
+    p = _rand_probs(key)
+    unlabeled = jnp.ones(p.shape[1], dtype=bool).at[:30].set(False)
+    picked, _ = deep.batchbald_select(p, unlabeled, k=5)
+    assert (np.asarray(picked) >= 30).all()
+
+
+def test_batchbald_joint_entropy_pairs_subadditive(key):
+    """I(y1,y2;w) <= I(y1;w)+I(y2;w): batch score at k=2 never exceeds the sum
+    of the two marginal BALD scores (submodularity sanity)."""
+    p = _rand_probs(key, s=8, n=20, c=2)
+    unlabeled = jnp.ones(20, dtype=bool)
+    picked, scores = deep.batchbald_select(p, unlabeled, k=2)
+    bald = np.asarray(deep.bald_score(p))
+    i0, i1 = np.asarray(picked)
+    joint_mi = float(scores[1])
+    # submodularity: max marginal <= I(y1,y2;w) <= I(y1;w) + I(y2;w)
+    assert joint_mi <= bald[i0] + bald[i1] + 1e-4
+    assert joint_mi >= bald[i0] - 1e-4
+
+
+def test_mlp_learner_fits_separable(key):
+    n, d = 400, 6
+    x = jax.random.normal(key, (n, d))
+    y = (x[:, 0] > 0).astype(jnp.int32)
+    lr = NeuralLearner(MLP(n_classes=2, hidden=(32,)), (d,), train_steps=150, mc_samples=4)
+    st = lr.init(jax.random.key(0))
+    mask = jnp.ones(n, dtype=bool)
+    st = lr.fit_on_mask(st, x, y, mask, jax.random.key(1))
+    assert lr.accuracy(st, x, y) > 0.9
+    probs = lr.predict_proba(st, x)
+    np.testing.assert_allclose(np.asarray(probs.sum(-1)), 1.0, atol=1e-5)
+    samples = lr.predict_proba_samples(st, x, jax.random.key(2))
+    assert samples.shape == (4, n, 2)
+    assert not np.allclose(np.asarray(samples[0]), np.asarray(samples[1]))  # dropout varies
+
+
+def test_chunked_prediction_matches_direct(key):
+    n, d = 130, 4
+    x = jax.random.normal(key, (n, d))
+    lr = NeuralLearner(MLP(n_classes=2, hidden=(16,)), (d,), predict_chunk=32)
+    st = lr.init(jax.random.key(0))
+    lr_big = NeuralLearner(MLP(n_classes=2, hidden=(16,)), (d,), predict_chunk=1024)
+    p1 = np.asarray(lr.predict_proba(st, x))
+    p2 = np.asarray(lr_big.predict_proba(st, x))
+    np.testing.assert_allclose(p1, p2, atol=1e-5)
+
+
+@pytest.mark.parametrize("strategy", ["bald", "batchbald", "random"])
+def test_neural_loop_end_to_end_tabular(strategy):
+    kx = jax.random.key(3)
+    n, d = 300, 5
+    x = jax.random.normal(kx, (n, d))
+    y = (x[:, 0] + 0.5 * x[:, 1] > 0).astype(jnp.int32)
+    lr = NeuralLearner(MLP(n_classes=2, hidden=(32,)), (d,), train_steps=60, mc_samples=4)
+    cfg = NeuralExperimentConfig(strategy=strategy, window_size=8, n_start=10, max_rounds=3)
+    res = run_neural_experiment(cfg, lr, x, y, x[:100], y[:100])
+    assert len(res.records) == 3
+    assert res.records[-1].n_labeled == 10 + 3 * 8
+    assert 0.0 <= res.final_accuracy <= 1.0
+
+
+def test_neural_loop_cnn_image_shape():
+    k = jax.random.key(4)
+    n = 96
+    x = jax.random.normal(k, (n, 8, 8, 3))  # CIFAR-like (smaller for CI speed)
+    y = (x.mean(axis=(1, 2, 3)) > 0).astype(jnp.int32)
+    lr = NeuralLearner(
+        SmallCNN(n_classes=2, dropout_rate=0.1), (8, 8, 3), train_steps=30, mc_samples=3
+    )
+    cfg = NeuralExperimentConfig(strategy="entropy", window_size=6, n_start=8, max_rounds=2)
+    res = run_neural_experiment(cfg, lr, x, y, x[:32], y[:32])
+    assert len(res.records) == 2
+
+
+def test_unknown_deep_strategy_raises():
+    lr = NeuralLearner(MLP(n_classes=2), (3,))
+    with pytest.raises(KeyError, match="unknown deep strategy"):
+        run_neural_experiment(
+            NeuralExperimentConfig(strategy="nope"),
+            lr,
+            np.zeros((10, 3), np.float32),
+            np.zeros(10, np.int32),
+            np.zeros((5, 3), np.float32),
+            np.zeros(5, np.int32),
+        )
+    assert "batchbald" in available_deep_strategies()

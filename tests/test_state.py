@@ -77,3 +77,23 @@ def test_pool_state_is_pytree(key):
     assert len(leaves) >= 4
     s_moved = jax.tree_util.tree_map(lambda a: a, s)
     assert isinstance(s_moved, PoolState)
+
+
+def test_set_start_state_multiclass_seeds_each_class(key):
+    """CIFAR/AG-News configs: one seed per present class (labels may not start at 0)."""
+    import jax.numpy as jnp
+    x = jax.random.normal(key, (200, 4))
+    y = jnp.asarray(np.random.default_rng(0).integers(1, 5, size=200), dtype=jnp.int32)
+    s = set_start_state(init_pool_state(x, y, key), n_start=12, n_classes=5)
+    assert int(labeled_count(s)) == 12
+    labeled_y = np.asarray(s.oracle_y)[np.asarray(s.labeled_mask)]
+    for c in range(1, 5):
+        assert (labeled_y == c).any(), f"class {c} not seeded"
+
+
+def test_set_start_state_single_class_raises(key):
+    x = np.random.randn(50, 2).astype("float32")
+    y = np.ones(50, dtype="int32")
+    import pytest
+    with pytest.raises(ValueError, match="two classes"):
+        set_start_state(init_pool_state(x, y, key), n_start=4)
