@@ -1,0 +1,97 @@
+"""GEMM forest kernel vs the gather kernel and sklearn oracle."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from sklearn.ensemble import RandomForestClassifier, RandomForestRegressor
+
+from distributed_active_learning_tpu.config import ForestConfig
+from distributed_active_learning_tpu.models.forest import (
+    fit_forest_classifier,
+    pack_sklearn_forest,
+)
+from distributed_active_learning_tpu.ops.trees import (
+    predict_leaves,
+    predict_proba,
+    predict_votes,
+)
+from distributed_active_learning_tpu.ops.trees_gemm import (
+    gemm_forest_from_packed,
+    predict_leaves_gemm,
+    predict_proba_gemm,
+    predict_votes_gemm,
+)
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(500, 7)).astype(np.float32)
+    y = (x[:, 0] + 0.5 * x[:, 1] - x[:, 2] > 0).astype(np.int32)
+    return x, y
+
+
+def test_gemm_matches_gather_classifier(data):
+    x, y = data
+    packed = fit_forest_classifier(x, y, ForestConfig(n_trees=10, max_depth=5))
+    gf = gemm_forest_from_packed(packed)
+    lg = np.asarray(predict_leaves(packed, jnp.asarray(x)))
+    lm = np.asarray(predict_leaves_gemm(gf, jnp.asarray(x)))
+    np.testing.assert_allclose(lm, lg, atol=1e-6)
+    np.testing.assert_array_equal(
+        np.asarray(predict_votes_gemm(gf, jnp.asarray(x))),
+        np.asarray(predict_votes(packed, jnp.asarray(x))),
+    )
+
+
+def test_gemm_matches_sklearn_proba(data):
+    x, y = data
+    model = RandomForestClassifier(n_estimators=8, max_depth=6, random_state=1)
+    model.fit(x, y)
+    gf = gemm_forest_from_packed(pack_sklearn_forest(model))
+    ours = np.asarray(predict_proba_gemm(gf, jnp.asarray(x)))
+    oracle = model.predict_proba(x)[:, list(model.classes_).index(1)]
+    np.testing.assert_allclose(ours, oracle, atol=1e-5)
+
+
+def test_gemm_matches_sklearn_regressor(data):
+    x, _ = data
+    target = (np.sin(x[:, 0]) + x[:, 1]).astype(np.float32)
+    model = RandomForestRegressor(n_estimators=6, max_depth=5, random_state=2)
+    model.fit(x, target)
+    gf = gemm_forest_from_packed(pack_sklearn_forest(model))
+    ours = np.asarray(predict_leaves_gemm(gf, jnp.asarray(x))).mean(axis=1)
+    np.testing.assert_allclose(ours, model.predict(x), atol=1e-4)
+
+
+def test_gemm_chunked_matches_unchunked(data):
+    x, y = data
+    packed = fit_forest_classifier(x, y, ForestConfig(n_trees=5, max_depth=4))
+    gf = gemm_forest_from_packed(packed)
+    a = np.asarray(predict_leaves_gemm(gf, jnp.asarray(x), chunk=64))
+    b = np.asarray(predict_leaves_gemm(gf, jnp.asarray(x), chunk=100000))
+    np.testing.assert_allclose(a, b, atol=1e-6)
+
+
+def test_gemm_jit_and_stump_edge(data):
+    """Depth-1 stumps and single-leaf (single-class) trees must convert."""
+    x, _ = data
+    y = np.ones(len(x), dtype=np.int32)
+    packed = fit_forest_classifier(x[:30], y[:30], ForestConfig(n_trees=3, max_depth=2))
+    gf = gemm_forest_from_packed(packed)
+    out = jax.jit(lambda g, a: predict_proba_gemm(g, a))(gf, jnp.asarray(x[:16]))
+    np.testing.assert_allclose(np.asarray(out), 1.0, atol=1e-6)
+
+
+def test_gemm_exactly_one_leaf_hit(data):
+    """Every point lands in exactly one leaf per tree (partition property)."""
+    x, y = data
+    packed = fit_forest_classifier(x, y, ForestConfig(n_trees=4, max_depth=5))
+    gf = gemm_forest_from_packed(packed)
+    T, I = gf.feat_ids.shape
+    feat_vals = jnp.take(jnp.asarray(x), gf.feat_ids.reshape(-1), axis=1)
+    c = (feat_vals <= gf.thresholds.reshape(-1)).astype(jnp.float32).reshape(-1, T, I)
+    s = jnp.einsum("nti,til->ntl", c, gf.path)
+    hits = (s == gf.target[None]).sum(axis=-1)
+    np.testing.assert_array_equal(np.asarray(hits), 1)
