@@ -37,6 +37,10 @@ def main():
     ap.add_argument("--window", type=int, default=100)
     ap.add_argument("--iters", type=int, default=10)
     ap.add_argument("--train-rows", type=int, default=5000)
+    ap.add_argument(
+        "--kernel", choices=["gemm", "gather"], default="gemm",
+        help="forest evaluation kernel (gemm = MXU path-matrix form)",
+    )
     args = ap.parse_args()
 
     import jax
@@ -44,18 +48,24 @@ def main():
 
     from distributed_active_learning_tpu.config import ForestConfig
     from distributed_active_learning_tpu.models.forest import fit_forest_classifier
+    from distributed_active_learning_tpu.ops import forest_eval
     from distributed_active_learning_tpu.ops.topk import select_bottom_k
     from distributed_active_learning_tpu.ops.scoring import uncertainty_score
-    from distributed_active_learning_tpu.ops.trees import predict_votes
 
     rng = np.random.default_rng(0)
     pool = rng.normal(size=(args.pool, args.features)).astype(np.float32)
     train_x = rng.normal(size=(args.train_rows, args.features)).astype(np.float32)
     train_y = (train_x[:, 0] + 0.3 * train_x[:, 1] > 0).astype(np.int32)
 
-    forest = fit_forest_classifier(
-        train_x, train_y, ForestConfig(n_trees=args.trees, max_depth=args.depth)
+    forest = forest_eval.for_kernel(
+        fit_forest_classifier(
+            train_x, train_y, ForestConfig(n_trees=args.trees, max_depth=args.depth)
+        ),
+        args.kernel,
     )
+    # for_kernel falls back to gather past its depth cap — report what ran.
+    from distributed_active_learning_tpu.ops.trees_gemm import GemmForest
+    kernel_used = "gemm" if isinstance(forest, GemmForest) else "gather"
     pool_dev = jax.device_put(jnp.asarray(pool))
     unlabeled = jnp.ones(args.pool, dtype=bool)
 
@@ -63,7 +73,7 @@ def main():
 
     @jax.jit
     def acquisition(forest, x, mask):
-        votes = predict_votes(forest, x)
+        votes = forest_eval.votes(forest, x)
         scores = uncertainty_score(votes.astype(jnp.float32) / forest.n_trees)
         vals, idx = select_bottom_k(scores, mask, window)
         return scores, idx
@@ -87,7 +97,7 @@ def main():
             {
                 "metric": "acquisition_scores_per_sec",
                 "value": round(scores_per_sec, 1),
-                "unit": f"scores/s ({args.pool}x{args.features} pool, {args.trees} trees, depth {args.depth})",
+                "unit": f"scores/s ({args.pool}x{args.features} pool, {args.trees} trees, depth {args.depth}, {kernel_used} kernel)",
                 "vs_baseline": round(scores_per_sec / spark_scores_per_sec, 1),
             }
         )

@@ -42,22 +42,51 @@ bool read_file(const char* path, std::string& out) {
 
 inline bool is_line_break(char c) { return c == '\n' || c == '\r'; }
 
+// Only whitespace counts as blank: a comma-only CSV line (",,") is a data row
+// of empty fields and must be *rejected* by parse_line, not skipped — the
+// numpy fallback raises on it and acceptance must match.
 inline bool is_blank_line(const char* p, const char* end) {
   for (; p != end && !is_line_break(*p); ++p) {
-    if (*p != ' ' && *p != '\t' && *p != ',') return false;
+    if (*p != ' ' && *p != '\t') return false;
   }
   return true;
 }
 
 // Parse one line's fields into out (appending). Returns field count, or -1 on
-// a token that fails to parse as a float. With out == nullptr only counts
-// tokens (no strtof) — the cheap dimension-counting pass.
+// a token that fails to parse as a float (or, in CSV mode, an empty field).
+// With out == nullptr only counts tokens (no strtof) — the cheap
+// dimension-counting pass.
 long parse_line(const char* p, const char* end, bool csv, std::vector<float>* out) {
   long count = 0;
+  if (csv) {
+    // Comma-separated: exactly one comma between fields. An empty field (as in
+    // "1,,2" or a trailing comma) is a parse error, matching the numpy
+    // fallback which raises on it — acceptance must not depend on whether the
+    // .so is built.
+    while (true) {
+      const char* f = p;
+      while (p < end && !is_line_break(*p) && *p != ',') ++p;
+      const char* fe = p;
+      while (f < fe && (*f == ' ' || *f == '\t' || *f == '"')) ++f;
+      while (fe > f && (fe[-1] == ' ' || fe[-1] == '\t' || fe[-1] == '"')) --fe;
+      if (f == fe) return -1;  // empty field
+      if (out) {
+        char* next = nullptr;
+        float v = std::strtof(f, &next);
+        if (next != fe) return -1;  // not a single clean float token
+        out->push_back(v);
+      }
+      ++count;
+      if (p >= end || is_line_break(*p)) break;
+      ++p;  // consume the comma; next field must exist
+      if (p >= end || is_line_break(*p)) return -1;  // trailing comma
+    }
+    return count;
+  }
   while (p < end && !is_line_break(*p)) {
     // skip leading separators / quotes
     while (p < end && !is_line_break(*p) &&
-           (*p == ' ' || *p == '\t' || *p == '"' || (csv && *p == ','))) {
+           (*p == ' ' || *p == '\t' || *p == '"')) {
       ++p;
     }
     if (p >= end || is_line_break(*p)) break;
@@ -69,7 +98,7 @@ long parse_line(const char* p, const char* end, bool csv, std::vector<float>* ou
       p = next;
     } else {
       while (p < end && !is_line_break(*p) && *p != ' ' && *p != '\t' &&
-             *p != '"' && !(csv && *p == ',')) {
+             *p != '"') {
         ++p;
       }
     }

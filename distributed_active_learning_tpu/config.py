@@ -28,6 +28,12 @@ class ForestConfig:
     max_depth: int = 4
     max_bins: int = 32
     criterion: str = "gini"
+    # Device evaluation kernel: "gemm" re-expresses traversal as two batched
+    # MXU matmuls (ops/trees_gemm.py) — the fast path; "gather" keeps the
+    # vmapped pointer-chase (ops/trees.py). Both agree bit-for-bit on votes.
+    # Deep forests (max_depth > 10) automatically use "gather" (the path
+    # matrix grows O(4^depth); see ops.forest_eval.for_kernel).
+    kernel: str = "gemm"
     # Static node budget per tree for the packed representation. A binary tree of
     # depth D has at most 2^(D+1) - 1 nodes; loaders assert fit.
     node_budget: Optional[int] = None

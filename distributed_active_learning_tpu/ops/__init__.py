@@ -13,6 +13,14 @@ from distributed_active_learning_tpu.ops.trees import (
     predict_votes,
     predict_value,
 )
+from distributed_active_learning_tpu.ops.trees_gemm import (
+    GemmForest,
+    gemm_forest_from_packed,
+    predict_leaves_gemm,
+    predict_proba_gemm,
+    predict_votes_gemm,
+)
+from distributed_active_learning_tpu.ops import forest_eval
 from distributed_active_learning_tpu.ops.scoring import (
     uncertainty_score,
     positive_entropy,

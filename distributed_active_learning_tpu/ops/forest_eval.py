@@ -1,0 +1,88 @@
+"""Kernel-agnostic forest evaluation.
+
+Two device representations of the same fitted forest exist:
+
+- :class:`~distributed_active_learning_tpu.ops.trees.PackedForest` — gather
+  traversal, ``O(depth)`` memory, bound by per-element gather throughput;
+- :class:`~distributed_active_learning_tpu.ops.trees_gemm.GemmForest` — the
+  path-matrix form whose dominant work is two batched GEMMs the MXU tiles.
+
+Strategies and the round function call through these dispatchers so the kernel
+choice is a config knob (``ForestConfig.kernel``), not a code path: the pytree
+*type* of the forest argument selects the implementation at trace time, and
+both kernels agree bit-for-bit on votes/probabilities (asserted in
+``tests/test_trees_gemm.py``). This is the single launch that replaces the
+reference's per-tree Spark-job loop (``classes/active_learner.py:169-184``).
+"""
+
+from __future__ import annotations
+
+from typing import Union
+
+import jax.numpy as jnp
+
+from distributed_active_learning_tpu.ops import trees, trees_gemm
+
+Forest = Union[trees.PackedForest, trees_gemm.GemmForest]
+
+# Deepest forest converted to path-matrix form; beyond this the O(4^depth)
+# path tensor outgrows its MXU advantage (and, eventually, host memory).
+_GEMM_MAX_DEPTH = 10
+
+
+def _is_gemm(forest: Forest) -> bool:
+    return isinstance(forest, trees_gemm.GemmForest)
+
+
+def leaves(forest: Forest, x: jnp.ndarray) -> jnp.ndarray:
+    """Per-tree leaf values ``[n, T]`` via whichever kernel the forest carries."""
+    if _is_gemm(forest):
+        return trees_gemm.predict_leaves_gemm(forest, x)
+    return trees.predict_leaves(forest, x)
+
+
+def proba(forest: Forest, x: jnp.ndarray) -> jnp.ndarray:
+    """P(class 1) per point ``[n]`` (mean of per-tree leaf probabilities)."""
+    if _is_gemm(forest):
+        return trees_gemm.predict_proba_gemm(forest, x)
+    return trees.predict_proba(forest, x)
+
+
+def votes(forest: Forest, x: jnp.ndarray) -> jnp.ndarray:
+    """Hard positive-vote count per point ``[n]`` (``uncertainty_sampling.py:96``)."""
+    if _is_gemm(forest):
+        return trees_gemm.predict_votes_gemm(forest, x)
+    return trees.predict_votes(forest, x)
+
+
+def value(forest: Forest, x: jnp.ndarray) -> jnp.ndarray:
+    """Regression prediction per point ``[n]`` (the LAL-regressor predict,
+    ``active_learner.py:319-321``)."""
+    if _is_gemm(forest):
+        return trees_gemm.predict_proba_gemm(forest, x)
+    return trees.predict_value(forest, x)
+
+
+def for_kernel(forest: trees.PackedForest, kernel: str) -> Forest:
+    """Convert a freshly packed forest to the representation ``kernel`` names.
+
+    ``"gemm"`` (the default in :class:`ForestConfig`) builds the path-matrix
+    form once per fit — a host-side restructure that is trivial next to the
+    sklearn fit itself; ``"gather"`` keeps the traversal form.
+    """
+    if kernel == "gemm":
+        # The path matrix is O(T · 4^depth); past depth 10 (~4 MB/tree) the
+        # form stops paying for itself and would eventually OOM the host, so
+        # deep forests keep the gather traversal. Callers can detect which
+        # representation they got from the returned type.
+        d = forest.max_depth
+        if d > _GEMM_MAX_DEPTH:
+            return forest
+        # Depth-derived I/L budgets keep the path-matrix shapes identical
+        # across per-round refits, so the jitted round never recompiles.
+        return trees_gemm.gemm_forest_from_packed(
+            forest, n_internal=2**d - 1, n_leaves=2**d
+        )
+    if kernel == "gather":
+        return forest
+    raise ValueError(f"unknown forest kernel {kernel!r}; use 'gemm' or 'gather'")

@@ -54,8 +54,19 @@ class GemmForest:
         return self.feat_ids.shape[0]
 
 
-def gemm_forest_from_packed(packed: PackedForest) -> GemmForest:
-    """Convert the gather representation to path-matrix form (host-side)."""
+def gemm_forest_from_packed(
+    packed: PackedForest,
+    n_internal: int | None = None,
+    n_leaves: int | None = None,
+) -> GemmForest:
+    """Convert the gather representation to path-matrix form (host-side).
+
+    ``n_internal``/``n_leaves`` pad the I/L axes to fixed sizes (defaults: the
+    forest's actual maxima). AL refits a forest every round and fitted node
+    counts vary, so callers that jit over the result must pass depth-derived
+    budgets (``2^D - 1`` / ``2^D``) to keep shapes static across rounds —
+    :func:`ops.forest_eval.for_kernel` does.
+    """
     feature = np.asarray(packed.feature)
     threshold = np.asarray(packed.threshold)
     left = np.asarray(packed.left)
@@ -81,6 +92,15 @@ def gemm_forest_from_packed(packed: PackedForest) -> GemmForest:
         per_tree.append((internal, leaves))
         max_I = max(max_I, len(internal))
         max_L = max(max_L, len(leaves))
+
+    if n_internal is not None:
+        if max_I > n_internal:
+            raise ValueError(f"forest has {max_I} internal nodes > budget {n_internal}")
+        max_I = n_internal
+    if n_leaves is not None:
+        if max_L > n_leaves:
+            raise ValueError(f"forest has {max_L} leaves > budget {n_leaves}")
+        max_L = n_leaves
 
     feat_ids = np.zeros((T, max_I), dtype=np.int32)
     thresholds = np.full((T, max_I), -np.inf, dtype=np.float32)

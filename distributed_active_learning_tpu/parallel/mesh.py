@@ -8,7 +8,6 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from distributed_active_learning_tpu.ops.trees import PackedForest
 from distributed_active_learning_tpu.runtime.state import PoolState
 
 AXIS_DATA = "data"
@@ -70,14 +69,16 @@ def shard_pool_state(state: PoolState, mesh: Mesh) -> PoolState:
     )
 
 
-def shard_forest(forest: PackedForest, mesh: Mesh) -> PackedForest:
-    """Place the packed forest with trees sharded over the model axis."""
-    tree_sh = NamedSharding(mesh, forest_spec())
-    return PackedForest(
-        feature=jax.device_put(forest.feature, tree_sh),
-        threshold=jax.device_put(forest.threshold, tree_sh),
-        left=jax.device_put(forest.left, tree_sh),
-        right=jax.device_put(forest.right, tree_sh),
-        value=jax.device_put(forest.value, tree_sh),
-        max_depth=forest.max_depth,
-    )
+def shard_forest(forest, mesh: Mesh):
+    """Place a forest with trees sharded over the model axis.
+
+    Works for both device representations (gather ``PackedForest`` and MXU
+    ``GemmForest``): every array field carries the tree axis first, so each
+    leaf is sharded ``P(model, None, ...)`` to its rank.
+    """
+
+    def place(leaf):
+        spec = P(AXIS_MODEL, *([None] * (leaf.ndim - 1)))
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+
+    return jax.tree.map(place, forest)

@@ -50,9 +50,9 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--quiet", action="store_true")
     ap.add_argument("--json", action="store_true", help="print per-round records as JSON lines")
     ap.add_argument("--list", action="store_true", help="list datasets and strategies")
-    # Neural (deep-AL) mode: an MLP learner over the tabular pool with MC-dropout
-    # acquisition. Selected automatically when --strategy names a deep strategy.
-    ap.add_argument("--neural", action="store_true", help="force the neural-learner path")
+    # Neural (deep-AL) mode: a neural learner over the pool with MC-dropout
+    # acquisition. Selected by --neural or a "deep.*"-namespaced strategy name.
+    ap.add_argument("--neural", action="store_true", help="use the neural-learner path")
     ap.add_argument("--train-steps", type=int, default=200)
     ap.add_argument("--mc-samples", type=int, default=8)
     ap.add_argument("--hidden", default="128,64", help="MLP hidden sizes (neural mode)")
@@ -60,7 +60,8 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv=None) -> int:
-    args = build_parser().parse_args(argv)
+    ap = build_parser()
+    args = ap.parse_args(argv)
 
     if args.list:
         from distributed_active_learning_tpu.data import available_datasets
@@ -76,14 +77,43 @@ def main(argv=None) -> int:
 
     from distributed_active_learning_tpu.runtime.debugger import Debugger
     from distributed_active_learning_tpu.runtime.loop import run_experiment
-    from distributed_active_learning_tpu.runtime.neural_loop import _SCORES as _DEEP
 
     dbg = Debugger(enabled=not args.quiet)
-    deep_names = set(_DEEP) | {"batchbald"}
-    if args.neural or args.strategy in deep_names:
+    # The neural (deep-AL) loop runs only when asked for explicitly: via
+    # --neural or a namespaced "deep.*" strategy name. Names living in both
+    # registries (e.g. "entropy") default to the classic forest path, which is
+    # the reference-parity target (density_weighting.py:148).
+    if args.neural or args.strategy.startswith("deep."):
+        if args.checkpoint_dir or args.checkpoint_every:
+            ap.error(
+                "--checkpoint-dir/--checkpoint-every are not supported on the "
+                "neural path; drop them or use the forest loop"
+            )
+        from distributed_active_learning_tpu.runtime.neural_loop import (
+            available_deep_strategies,
+            is_deep_strategy,
+        )
+
+        if not is_deep_strategy(args.strategy):
+            ap.error(
+                f"--neural needs a deep strategy, got {args.strategy!r}; "
+                f"pick one of: {', '.join(available_deep_strategies())}"
+            )
         result = _run_neural(args, dbg)
         _emit(args, result, dbg)
         return 0
+
+    from distributed_active_learning_tpu.runtime.neural_loop import is_deep_strategy
+    from distributed_active_learning_tpu.strategies import available_strategies
+
+    if args.strategy not in available_strategies() and is_deep_strategy(args.strategy):
+        # Round-1 accepted bare deep names ("bald"); now they are namespaced so
+        # classic/deep collisions are unambiguous — point movers at the new
+        # spelling instead of an uncaught registry KeyError.
+        ap.error(
+            f"{args.strategy!r} is a deep strategy; spell it "
+            f"'deep.{args.strategy}' (or pass --neural)"
+        )
 
     cfg = ExperimentConfig(
         data=DataConfig(
@@ -128,7 +158,7 @@ def _run_neural(args, dbg):
         mc_samples=args.mc_samples,
     )
     cfg = NeuralExperimentConfig(
-        strategy=args.strategy if args.strategy != "uncertainty" else "bald",
+        strategy=args.strategy,
         window_size=args.window,
         n_start=args.n_start,
         max_rounds=args.rounds,

@@ -29,8 +29,8 @@ from distributed_active_learning_tpu.models.forest import (
     fit_forest_classifier,
     fit_forest_regressor,
 )
+from distributed_active_learning_tpu.ops import forest_eval
 from distributed_active_learning_tpu.ops.topk import select_bottom_k, select_top_k
-from distributed_active_learning_tpu.ops.trees import PackedForest, predict_proba
 from distributed_active_learning_tpu.runtime import state as state_lib
 from distributed_active_learning_tpu.runtime.debugger import Debugger
 from distributed_active_learning_tpu.runtime.results import ExperimentResult, RoundRecord
@@ -46,7 +46,7 @@ def make_round_fn(strategy: Strategy, window_size: int):
 
     @jax.jit
     def round_fn(
-        forest: PackedForest, state: state_lib.PoolState, aux: StrategyAux
+        forest: forest_eval.Forest, state: state_lib.PoolState, aux: StrategyAux
     ) -> Tuple[state_lib.PoolState, jnp.ndarray, jnp.ndarray]:
         key, k_score = jax.random.split(state.key)
         state = state.replace(key=key)
@@ -63,9 +63,9 @@ def make_round_fn(strategy: Strategy, window_size: int):
 
 
 @jax.jit
-def _accuracy(forest: PackedForest, test_x: jnp.ndarray, test_y: jnp.ndarray) -> jnp.ndarray:
+def _accuracy(forest: forest_eval.Forest, test_x: jnp.ndarray, test_y: jnp.ndarray) -> jnp.ndarray:
     """Test accuracy on device (``uncertainty_sampling.py:79-83``)."""
-    pred = (predict_proba(forest, test_x) > 0.5).astype(jnp.int32)
+    pred = (forest_eval.proba(forest, test_x) > 0.5).astype(jnp.int32)
     return jnp.mean((pred == test_y).astype(jnp.float32))
 
 
@@ -157,7 +157,10 @@ def run_experiment(
 
         with dbg.phase("train"):
             lx, ly = _labeled_subset(state, host_x, host_y)
-            forest = fit_forest_classifier(lx, ly, cfg.forest, seed=cfg.seed + round_idx)
+            packed = fit_forest_classifier(lx, ly, cfg.forest, seed=cfg.seed + round_idx)
+            # One representation conversion per fit; the round + accuracy then
+            # run on the configured kernel (MXU GEMM by default).
+            forest = forest_eval.for_kernel(packed, cfg.forest.kernel)
         train_time = dbg.records[-1][1]
 
         with dbg.phase("round"):
@@ -165,7 +168,10 @@ def run_experiment(
             acc = float(_accuracy(forest, test_x, test_y))
         score_time = dbg.records[-1][1]
 
-        n_labeled = int(state_lib.labeled_count(state))
+        # The record pairs the accuracy with the labeled count the evaluated
+        # forest was *trained on* (pre-reveal), matching the reference's print
+        # ordering ("labeled = 10 ... accu(trained on 10)",
+        # uncertainty_sampling.py:65,113).
         rec = RoundRecord(
             round=round_idx,
             n_labeled=n_labeled,

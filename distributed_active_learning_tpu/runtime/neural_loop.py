@@ -32,8 +32,24 @@ _SCORES: Dict[str, Callable[[jnp.ndarray], jnp.ndarray]] = {
 }
 
 
+def _deep_names():
+    """The one source of truth for valid deep-strategy (bare) names."""
+    return set(_SCORES) | {"batchbald", "random"}
+
+
 def available_deep_strategies():
-    return sorted(_SCORES) + ["batchbald", "random"]
+    """Namespaced names ("deep.bald", ...) — the CLI routes on the prefix so
+    names shared with the classic registry (e.g. "entropy") stay unambiguous."""
+    return sorted("deep." + n for n in _deep_names())
+
+
+def _normalize_deep_name(name: str) -> str:
+    return name[len("deep."):] if name.startswith("deep.") else name
+
+
+def is_deep_strategy(name: str) -> bool:
+    """True if ``name`` (bare or "deep."-prefixed) names a deep strategy."""
+    return _normalize_deep_name(name) in _deep_names()
 
 
 @dataclasses.dataclass(frozen=True)
@@ -58,7 +74,8 @@ def run_neural_experiment(
     debugger: Optional[Debugger] = None,
 ) -> ExperimentResult:
     dbg = debugger or Debugger(enabled=False)
-    if cfg.strategy not in _SCORES and cfg.strategy not in ("batchbald", "random"):
+    strat = _normalize_deep_name(cfg.strategy)
+    if strat not in _deep_names():
         raise KeyError(
             f"unknown deep strategy {cfg.strategy!r}; available: {available_deep_strategies()}"
         )
@@ -106,23 +123,24 @@ def run_neural_experiment(
 
         with dbg.phase("acquire"):
             unlabeled = ~state.labeled_mask
-            if cfg.strategy == "random":
+            if strat == "random":
                 scores = jax.random.uniform(k_rand, (n_pool,))
                 _, picked = select_top_k(scores, unlabeled, cfg.window_size)
-            elif cfg.strategy == "batchbald":
+            elif strat == "batchbald":
                 probs = learner.predict_proba_samples(net_state, pool_x, k_mc)
                 picked, _ = deep.batchbald_select(
                     probs, unlabeled, cfg.window_size, cfg.batchbald_max_configs
                 )
             else:
                 probs = learner.predict_proba_samples(net_state, pool_x, k_mc)
-                scores = _SCORES[cfg.strategy](probs)
+                scores = _SCORES[strat](probs)
                 _, picked = select_top_k(scores, unlabeled, cfg.window_size)
             state = state_lib.reveal(state, picked)
             acc = learner.accuracy(net_state, test_x, test_y)
         score_time = dbg.records[-1][1]
 
-        n_labeled = int(state_lib.labeled_count(state))
+        # Pre-reveal count: the accuracy was measured on the network trained on
+        # this many labels (same record semantics as runtime.loop).
         result.append(
             RoundRecord(
                 round=round_idx,

@@ -11,8 +11,7 @@ import jax
 import jax.numpy as jnp
 
 from distributed_active_learning_tpu.config import StrategyConfig
-from distributed_active_learning_tpu.ops import scoring, similarity
-from distributed_active_learning_tpu.ops.trees import PackedForest, predict_votes
+from distributed_active_learning_tpu.ops import forest_eval, scoring, similarity
 from distributed_active_learning_tpu.runtime.state import PoolState
 from distributed_active_learning_tpu.strategies.base import (
     Strategy,
@@ -21,11 +20,14 @@ from distributed_active_learning_tpu.strategies.base import (
 )
 
 
-def _vote_fraction(forest: PackedForest, state: PoolState) -> jnp.ndarray:
+def _vote_fraction(forest: forest_eval.Forest, state: PoolState) -> jnp.ndarray:
     """Positive-vote fraction per pool point — the probability estimate every
     reference strategy derives from the per-tree vote sum
-    (``uncertainty_sampling.py:96-98``: votes from hard per-tree predictions)."""
-    votes = predict_votes(forest, state.x)
+    (``uncertainty_sampling.py:96-98``: votes from hard per-tree predictions).
+
+    Dispatches through :mod:`ops.forest_eval`, so the MXU (GEMM) kernel is used
+    whenever the round was built with ``ForestConfig.kernel="gemm"``."""
+    votes = forest_eval.votes(forest, state.x)
     return votes.astype(jnp.float32) / forest.n_trees
 
 

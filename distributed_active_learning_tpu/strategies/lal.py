@@ -29,12 +29,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from distributed_active_learning_tpu.config import StrategyConfig
-from distributed_active_learning_tpu.ops import scoring
-from distributed_active_learning_tpu.ops.trees import (
-    PackedForest,
-    predict_value,
-    predict_votes,
-)
+from distributed_active_learning_tpu.ops import forest_eval, scoring
 from distributed_active_learning_tpu.runtime.state import PoolState
 from distributed_active_learning_tpu.strategies.base import (
     Strategy,
@@ -43,9 +38,9 @@ from distributed_active_learning_tpu.strategies.base import (
 )
 
 
-def lal_features(forest: PackedForest, state: PoolState) -> jnp.ndarray:
+def lal_features(forest: forest_eval.Forest, state: PoolState) -> jnp.ndarray:
     """The ``[n, 5]`` LAL feature matrix (columns f_1, f_2, f_3, f_6, f_8)."""
-    votes = predict_votes(forest, state.x).astype(jnp.float32)
+    votes = forest_eval.votes(forest, state.x).astype(jnp.float32)
     f1 = votes / forest.n_trees
     f2 = scoring.vote_sd(votes, forest.n_trees)
 
@@ -91,6 +86,6 @@ def _lal(cfg: StrategyConfig) -> Strategy:
                 "reduction regressor); see models/lal_training.py"
             )
         feats = lal_features(forest, state)
-        return predict_value(aux.lal_forest, feats)
+        return forest_eval.value(aux.lal_forest, feats)
 
     return Strategy(name="lal", score=score, higher_is_better=True)

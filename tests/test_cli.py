@@ -33,7 +33,8 @@ def test_cli_json_records(capsys):
     assert rc == 0
     lines = [json.loads(l) for l in capsys.readouterr().out.strip().splitlines()]
     assert len(lines) == 2
-    assert lines[0]["n_labeled"] == 40  # 10 start + 30
+    assert lines[0]["n_labeled"] == 10  # pre-reveal count (the n_start seed set)
+    assert lines[1]["n_labeled"] == 40  # 10 start + 30 window
 
 
 def test_cli_unknown_dataset():
@@ -42,13 +43,51 @@ def test_cli_unknown_dataset():
 
 
 def test_cli_neural_strategy_dispatch(capsys):
-    """--strategy bald routes to the neural loop (the --list entries must be runnable)."""
+    """--strategy deep.bald routes to the neural loop (the --list entries must
+    be runnable)."""
     rc = main([
-        "--dataset", "checkerboard2x2", "--strategy", "bald", "--window", "10",
+        "--dataset", "checkerboard2x2", "--strategy", "deep.bald", "--window", "10",
         "--rounds", "2", "--quiet", "--json", "--train-steps", "30",
         "--mc-samples", "3", "--hidden", "16",
     ])
     assert rc == 0
     import json as _json
     lines = [_json.loads(l) for l in capsys.readouterr().out.strip().splitlines()]
-    assert len(lines) == 2 and lines[-1]["n_labeled"] == 30
+    assert len(lines) == 2 and lines[-1]["n_labeled"] == 20
+
+
+def test_cli_entropy_routes_to_forest_loop(capsys, monkeypatch):
+    """--strategy entropy (no --neural) must run the classic forest strategy
+    (density_weighting.py:148 parity), never the neural loop — the round-1
+    routing bug sent it to MC-dropout training."""
+    import distributed_active_learning_tpu.run as run_mod
+
+    def _boom(*a, **kw):  # pragma: no cover - failure path
+        raise AssertionError("entropy was routed to the neural loop")
+
+    monkeypatch.setattr(run_mod, "_run_neural", _boom)
+    rc = main([
+        "--dataset", "checkerboard2x2", "--strategy", "entropy", "--window", "30",
+        "--rounds", "2", "--quiet", "--json",
+    ])
+    assert rc == 0
+    lines = [json.loads(l) for l in capsys.readouterr().out.strip().splitlines()]
+    assert len(lines) == 2
+
+
+def test_cli_bare_neural_needs_deep_strategy():
+    """--neural with the default (classic) strategy must fail with a clean
+    argparse error, not an uncaught KeyError from the neural loop."""
+    with pytest.raises(SystemExit):
+        main(["--neural", "--rounds", "1", "--quiet"])
+
+
+def test_cli_neural_checkpoint_flags_rejected():
+    """Checkpoint flags are not supported on the neural path; silently ignoring
+    them would drop a user's crash-resume request."""
+    with pytest.raises(SystemExit):
+        main([
+            "--dataset", "checkerboard2x2", "--strategy", "deep.bald",
+            "--rounds", "1", "--quiet", "--checkpoint-dir", "/tmp/nope",
+            "--checkpoint-every", "1",
+        ])

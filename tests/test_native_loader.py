@@ -61,6 +61,28 @@ def test_native_rejects_ragged(tmp_path):
     assert _native.try_load_matrix(str(p), None) is None  # falls back, numpy raises
 
 
+def test_native_rejects_empty_csv_field(tmp_path):
+    """'1,,2' must be a parse error, not a 2-field row — the numpy fallback
+    raises on the empty field, and native/fallback acceptance must agree."""
+    p = tmp_path / "empty_field.csv"
+    p.write_text("a,b,c\n1,,2\n3,4,5\n")
+    assert _native._parse(str(p), is_csv=True) is None
+
+
+def test_native_rejects_trailing_comma(tmp_path):
+    p = tmp_path / "trailing.csv"
+    p.write_text("a,b\n1,2,\n")
+    assert _native._parse(str(p), is_csv=True) is None
+
+
+def test_native_rejects_comma_only_line(tmp_path):
+    """A ',,' row is all-empty fields, not a blank line — numpy raises, so the
+    native path must reject (not skip) it."""
+    p = tmp_path / "commas.csv"
+    p.write_text("a,b,c\n,,\n1,2,3\n")
+    assert _native._parse(str(p), is_csv=True) is None
+
+
 def test_load_labeled_text_uses_native(tmp_path):
     p = tmp_path / "striatum.txt"
     p.write_text("0.5 1.25 -1\n1.0 2.0 1\n")

@@ -84,6 +84,48 @@ def test_gemm_jit_and_stump_edge(data):
     np.testing.assert_allclose(np.asarray(out), 1.0, atol=1e-6)
 
 
+def test_for_kernel_shapes_static_across_refits(data):
+    """AL refits every round; with depth-derived budgets the path-matrix
+    shapes must not depend on the fitted trees (no per-round recompiles)."""
+    from distributed_active_learning_tpu.ops import forest_eval
+
+    x, y = data
+    cfg = ForestConfig(n_trees=6, max_depth=5, kernel="gemm")
+    gf_small = forest_eval.for_kernel(
+        fit_forest_classifier(x[:40], y[:40], cfg, seed=0), "gemm"
+    )
+    gf_big = forest_eval.for_kernel(
+        fit_forest_classifier(x, y, cfg, seed=1), "gemm"
+    )
+    assert gf_small.path.shape == gf_big.path.shape == (6, 31, 32)
+    # padded form still evaluates correctly
+    packed = fit_forest_classifier(x, y, cfg, seed=1)
+    np.testing.assert_allclose(
+        np.asarray(predict_proba_gemm(gf_big, jnp.asarray(x))),
+        np.asarray(predict_proba(packed, jnp.asarray(x))),
+        atol=1e-6,
+    )
+
+
+def test_for_kernel_deep_forest_falls_back_to_gather(data):
+    """Past the depth cap the path matrix is O(4^depth); for_kernel must keep
+    the gather form instead of building a multi-GB host array."""
+    from distributed_active_learning_tpu.ops import forest_eval
+    from distributed_active_learning_tpu.ops.trees import PackedForest
+
+    x, y = data
+    packed = fit_forest_classifier(x, y, ForestConfig(n_trees=3, max_depth=16))
+    out = forest_eval.for_kernel(packed, "gemm")
+    assert isinstance(out, PackedForest)
+
+
+def test_for_kernel_budget_too_small_raises(data):
+    x, y = data
+    packed = fit_forest_classifier(x, y, ForestConfig(n_trees=4, max_depth=6))
+    with pytest.raises(ValueError, match="budget"):
+        gemm_forest_from_packed(packed, n_internal=3, n_leaves=4)
+
+
 def test_gemm_exactly_one_leaf_hit(data):
     """Every point lands in exactly one leaf per tree (partition property)."""
     x, y = data
