@@ -1,0 +1,90 @@
+"""Disk persistence for packed forests.
+
+The reference persists models to HDFS with a try-load-else-train pattern
+(``mllib/save_regression_model.py:28-34``: ``RandomForestModel.load`` inside a
+``try``, falling back to train + ``save``; mirrored, commented out, for the
+2000-tree LAL regressor at ``classes/active_learner.py:354-365``). Notably the
+MLlib *classifier* save was observed broken (``mllib_random_forest_classifer.py:55-58``);
+here one format serves classifiers and regressors alike, since a
+:class:`PackedForest` is just five node arrays + a depth.
+
+Format: a single ``.npz`` (portable, atomic via temp-file rename) holding the
+node arrays and a format-version scalar.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_active_learning_tpu.ops.trees import PackedForest
+from distributed_active_learning_tpu.utils.io import atomic_savez
+
+_FORMAT_VERSION = 1
+
+
+def save_forest(path: str, forest: PackedForest, meta: Optional[str] = None) -> str:
+    """Write the packed forest to ``path`` (npz, atomic); returns the path.
+
+    ``meta`` is an opaque caller string (e.g. a hash of the training options)
+    stored alongside the arrays; :func:`load_or_train` uses it to detect a
+    file produced under different options.
+    """
+    payload = {
+        "version": np.asarray(_FORMAT_VERSION, dtype=np.int32),
+        "feature": np.asarray(forest.feature),
+        "threshold": np.asarray(forest.threshold),
+        "left": np.asarray(forest.left),
+        "right": np.asarray(forest.right),
+        "value": np.asarray(forest.value),
+        "max_depth": np.asarray(forest.max_depth, dtype=np.int32),
+    }
+    if meta is not None:
+        payload["meta"] = np.frombuffer(meta.encode(), dtype=np.uint8)
+    return atomic_savez(path, **payload)
+
+
+def load_forest(path: str) -> Tuple[PackedForest, Optional[str]]:
+    """Load ``(forest, meta)`` saved by :func:`save_forest`."""
+    with np.load(path) as z:
+        version = int(z["version"])
+        if version != _FORMAT_VERSION:
+            raise ValueError(f"unsupported forest format version {version}")
+        meta = bytes(z["meta"]).decode() if "meta" in z.files else None
+        return (
+            PackedForest(
+                feature=jnp.asarray(z["feature"]),
+                threshold=jnp.asarray(z["threshold"]),
+                left=jnp.asarray(z["left"]),
+                right=jnp.asarray(z["right"]),
+                value=jnp.asarray(z["value"]),
+                max_depth=int(z["max_depth"]),
+            ),
+            meta,
+        )
+
+
+def load_or_train(
+    path: str,
+    train_fn: Callable[[], PackedForest],
+    meta: Optional[str] = None,
+) -> PackedForest:
+    """The reference's resilience pattern (``save_regression_model.py:28-34``):
+    load the model from ``path`` if present, else train it and save it there.
+
+    When ``meta`` is given, a stored file whose meta differs (trained under
+    other options) is retrained and overwritten rather than silently reused.
+    """
+    if os.path.exists(path):
+        try:
+            forest, stored_meta = load_forest(path)
+            if meta is None or stored_meta == meta:
+                return forest
+        except (ValueError, KeyError, OSError):
+            pass  # corrupt/old file: retrain and overwrite
+    forest = train_fn()
+    save_forest(path, forest, meta=meta)
+    return forest

@@ -20,6 +20,7 @@ fitted regressor for single-launch device scoring.
 
 from __future__ import annotations
 
+import json
 from typing import Mapping, Optional, Tuple
 
 import jax
@@ -133,27 +134,47 @@ def load_or_train_lal_regressor(options: Mapping) -> PackedForest:
 
     ``options['lal_data_path']``: reference-format text file (5 features +
     target, whitespace, target last) like ``lal_randomtree_simulatedunbalanced_big.txt``.
-    Otherwise synthesizes a small dataset on the fly (cached per options).
+    ``options['lal_model_path']``: disk cache for the *fitted* regressor — the
+    reference's try-load-else-train pattern (``save_regression_model.py:28-34``;
+    the LAL variant at ``active_learner.py:360-365``), so a 2000-tree regressor
+    survives process restarts instead of being re-synthesized + refit.
+    Otherwise synthesizes a small dataset on the fly (cached per options,
+    in-memory).
     """
     key = tuple(sorted((k, str(v)) for k, v in options.items()))
     if key in _CACHE:
         return _CACHE[key]
-    path: Optional[str] = options.get("lal_data_path")
-    if path:
-        # single parse (native fast path when built); targets stay float
-        raw = _text_to_matrix(path, None)
-        feats, targets = raw[:, :-1], raw[:, -1]
-    else:
-        feats, targets = generate_lal_dataset(
+
+    def _train() -> PackedForest:
+        path: Optional[str] = options.get("lal_data_path")
+        if path:
+            # single parse (native fast path when built); targets stay float
+            raw = _text_to_matrix(path, None)
+            feats, targets = raw[:, :-1], raw[:, -1]
+        else:
+            feats, targets = generate_lal_dataset(
+                seed=int(options.get("lal_seed", 0)),
+                n_experiments=int(options.get("lal_experiments", 60)),
+            )
+        return train_lal_regressor(
+            feats,
+            targets,
+            n_trees=int(options.get("lal_trees", 200)),
+            max_depth=int(options.get("lal_depth", 10)),
             seed=int(options.get("lal_seed", 0)),
-            n_experiments=int(options.get("lal_experiments", 60)),
         )
-    packed = train_lal_regressor(
-        feats,
-        targets,
-        n_trees=int(options.get("lal_trees", 200)),
-        max_depth=int(options.get("lal_depth", 10)),
-        seed=int(options.get("lal_seed", 0)),
-    )
+
+    model_path: Optional[str] = options.get("lal_model_path")
+    if model_path:
+        from distributed_active_learning_tpu.models.forest_io import load_or_train
+
+        # Meta = the non-path training options: a file trained under different
+        # options (tree count, depth, data source) is retrained, not reused.
+        meta = json.dumps(
+            {k: str(v) for k, v in sorted(options.items()) if k != "lal_model_path"}
+        )
+        packed = load_or_train(model_path, _train, meta=meta)
+    else:
+        packed = _train()
     _CACHE[key] = packed
     return packed

@@ -57,10 +57,19 @@ def replicated_spec() -> P:
 def shard_pool_state(state: PoolState, mesh: Mesh) -> PoolState:
     """Place pool arrays with rows sharded over the data axis.
 
-    Pool sizes not divisible by the axis are handled by the caller padding the
-    pool (datasets here are padded at load when sharding is requested).
+    Pool sizes not divisible by the axis must be padded first with
+    :func:`runtime.state.pad_for_sharding` (``run_experiment`` does this when
+    a >1-device mesh is configured); this function raises otherwise rather
+    than let a shard_map kernel fail with an opaque block-shape error.
     """
-    return PoolState(
+    n = state.n_pool
+    data_axis = mesh.shape[AXIS_DATA]
+    if n % data_axis:
+        raise ValueError(
+            f"pool size {n} not divisible by data axis {data_axis}; call "
+            "runtime.state.pad_for_sharding first"
+        )
+    return state.replace(
         x=jax.device_put(state.x, NamedSharding(mesh, pool_spec())),
         oracle_y=jax.device_put(state.oracle_y, NamedSharding(mesh, mask_spec())),
         labeled_mask=jax.device_put(state.labeled_mask, NamedSharding(mesh, mask_spec())),

@@ -23,6 +23,7 @@ from distributed_active_learning_tpu.config import (
     DataConfig,
     ExperimentConfig,
     ForestConfig,
+    MeshConfig,
     StrategyConfig,
 )
 
@@ -47,6 +48,10 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--out", default=None, help="write reference-format results log")
     ap.add_argument("--checkpoint-dir", default=None)
     ap.add_argument("--checkpoint-every", type=int, default=0)
+    # Device mesh for the sharded round (1x1 = single device). Pool rows ride
+    # the data axis, trees the model axis; non-divisible pools are padded.
+    ap.add_argument("--mesh-data", type=int, default=1)
+    ap.add_argument("--mesh-model", type=int, default=1)
     ap.add_argument("--quiet", action="store_true")
     ap.add_argument("--json", action="store_true", help="print per-round records as JSON lines")
     ap.add_argument("--list", action="store_true", help="list datasets and strategies")
@@ -89,6 +94,11 @@ def main(argv=None) -> int:
                 "--checkpoint-dir/--checkpoint-every are not supported on the "
                 "neural path; drop them or use the forest loop"
             )
+        if args.mesh_data != 1 or args.mesh_model != 1:
+            ap.error(
+                "--mesh-data/--mesh-model are not supported on the neural "
+                "path yet; drop them or use the forest loop"
+            )
         from distributed_active_learning_tpu.runtime.neural_loop import (
             available_deep_strategies,
             is_deep_strategy,
@@ -124,6 +134,7 @@ def main(argv=None) -> int:
         ),
         forest=ForestConfig(n_trees=args.trees, max_depth=args.depth),
         strategy=StrategyConfig(name=args.strategy, window_size=args.window, beta=args.beta),
+        mesh=MeshConfig(data=args.mesh_data, model=args.mesh_model),
         n_start=args.n_start,
         max_rounds=args.rounds,
         label_budget=args.budget,

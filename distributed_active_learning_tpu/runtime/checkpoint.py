@@ -19,7 +19,6 @@ import dataclasses
 import json
 import os
 import re
-import tempfile
 from typing import Optional, Tuple
 
 import jax
@@ -32,7 +31,40 @@ from distributed_active_learning_tpu.runtime.state import PoolState
 _STEP_RE = re.compile(r"^alstate_(\d+)\.npz$")
 
 
-def save(ckpt_dir: str, state: PoolState, result: ExperimentResult) -> str:
+def config_fingerprint(cfg) -> str:
+    """Hash of the experiment's *identity* fields — dataset, forest, strategy,
+    mesh, seeding. Loop controls (max_rounds, label_budget, checkpoint/log
+    paths) are excluded: resuming with a larger round budget is legitimate;
+    resuming under a different strategy or dataset silently continues a
+    mismatched experiment, which :func:`restore_latest` refuses.
+    """
+    import hashlib
+
+    forest_ident = dataclasses.asdict(cfg.forest)
+    # The evaluation kernel is a pure-performance knob (gather/gemm agree
+    # bit-for-bit on votes) — switching it between runs is a legitimate resume.
+    forest_ident.pop("kernel", None)
+    ident = {
+        "data": dataclasses.asdict(cfg.data),
+        "forest": forest_ident,
+        "strategy": {
+            **dataclasses.asdict(cfg.strategy),
+            "options": dict(cfg.strategy.options),
+        },
+        "mesh": dataclasses.asdict(cfg.mesh),
+        "n_start": cfg.n_start,
+        "seed": cfg.seed,
+    }
+    blob = json.dumps(ident, sort_keys=True, default=str).encode()
+    return hashlib.sha256(blob).hexdigest()[:16]
+
+
+def save(
+    ckpt_dir: str,
+    state: PoolState,
+    result: ExperimentResult,
+    fingerprint: Optional[str] = None,
+) -> str:
     """Write a checkpoint for the state's current round; returns the path."""
     os.makedirs(ckpt_dir, exist_ok=True)
     step = int(state.round)
@@ -45,16 +77,13 @@ def save(ckpt_dir: str, state: PoolState, result: ExperimentResult) -> str:
             dtype=np.uint8,
         ),
     }
-    final = os.path.join(ckpt_dir, f"alstate_{step}.npz")
-    fd, tmp = tempfile.mkstemp(dir=ckpt_dir, suffix=".tmp")
-    try:
-        with os.fdopen(fd, "wb") as f:
-            np.savez(f, **payload)
-        os.replace(tmp, final)  # atomic publish
-    finally:
-        if os.path.exists(tmp):
-            os.unlink(tmp)
-    return final
+    if fingerprint is not None:
+        payload["config_fingerprint"] = np.frombuffer(
+            fingerprint.encode(), dtype=np.uint8
+        )
+    from distributed_active_learning_tpu.utils.io import atomic_savez
+
+    return atomic_savez(os.path.join(ckpt_dir, f"alstate_{step}.npz"), **payload)
 
 
 def latest_step(ckpt_dir: str) -> Optional[int]:
@@ -69,9 +98,17 @@ def latest_step(ckpt_dir: str) -> Optional[int]:
 
 
 def restore_latest(
-    ckpt_dir: str, state: PoolState, result: ExperimentResult
+    ckpt_dir: str,
+    state: PoolState,
+    result: ExperimentResult,
+    fingerprint: Optional[str] = None,
 ) -> Optional[Tuple[PoolState, ExperimentResult]]:
-    """Load the newest checkpoint into (state, result); None if none exists."""
+    """Load the newest checkpoint into (state, result); None if none exists.
+
+    With ``fingerprint`` set, a stored fingerprint that differs raises — the
+    checkpoint belongs to a different experiment (strategy/dataset/forest/seed)
+    and silently continuing it would corrupt the run.
+    """
     step = latest_step(ckpt_dir)
     if step is None:
         return None
@@ -80,6 +117,16 @@ def restore_latest(
         key = jax.random.wrap_key_data(jnp.asarray(z["key"]))
         rnd = jnp.asarray(z["round"])
         records = json.loads(bytes(z["records_json"]).decode())
+        stored_fp = (
+            bytes(z["config_fingerprint"]).decode()
+            if "config_fingerprint" in z.files
+            else None
+        )
+    if fingerprint is not None and stored_fp is not None and stored_fp != fingerprint:
+        raise ValueError(
+            f"checkpoint config fingerprint {stored_fp} != current experiment "
+            f"{fingerprint}: refusing to resume a different experiment's state"
+        )
     if mask.shape != state.labeled_mask.shape:
         raise ValueError(
             f"checkpoint pool size {mask.shape} != experiment pool {state.labeled_mask.shape}"

@@ -82,9 +82,10 @@ def _labeled_subset(
     so only the boolean mask crosses the device boundary per round — not the
     full [n, d] pool.
     """
-    mask = np.asarray(state.labeled_mask)
-    x = (host_x if host_x is not None else np.asarray(state.x))[mask]
-    y = (host_y if host_y is not None else np.asarray(state.oracle_y))[mask]
+    # Slice off mesh-padding rows: host arrays are unpadded.
+    mask = np.asarray(state.labeled_mask)[: state.n_valid]
+    x = (host_x if host_x is not None else np.asarray(state.x)[: state.n_valid])[mask]
+    y = (host_y if host_y is not None else np.asarray(state.oracle_y)[: state.n_valid])[mask]
     return x, y
 
 
@@ -128,8 +129,35 @@ def run_experiment(
     state = state_lib.set_start_state(state, cfg.n_start)
 
     strategy = get_strategy(cfg.strategy)
+
+    # Distribution: when the config names a >1-device mesh, pad the pool to
+    # data-axis divisibility, place state/forest shardings, and let GSPMD
+    # compile the same round function into one SPMD program (the replacement
+    # for the reference's executor-partitioned RDDs, SURVEY.md §2.4).
+    mesh = None
+    if cfg.mesh.data * cfg.mesh.model > 1:
+        from distributed_active_learning_tpu.parallel import (
+            make_mesh,
+            make_sharded_round_fn,
+            shard_forest,
+            shard_pool_state,
+        )
+
+        if cfg.forest.n_trees % cfg.mesh.model:
+            raise ValueError(
+                f"n_trees={cfg.forest.n_trees} not divisible by mesh "
+                f"model axis {cfg.mesh.model}"
+            )
+        mesh = make_mesh(data=cfg.mesh.data, model=cfg.mesh.model)
+        state = state_lib.pad_for_sharding(state, cfg.mesh.data)
+        state = shard_pool_state(state, mesh)
+        round_fn = make_sharded_round_fn(strategy, cfg.strategy.window_size, mesh)
+        place_forest = lambda f: shard_forest(f, mesh)
+    else:
+        round_fn = make_round_fn(strategy, cfg.strategy.window_size)
+        place_forest = lambda f: f
+
     aux = build_aux(cfg, state)
-    round_fn = make_round_fn(strategy, cfg.strategy.window_size)
 
     result = ExperimentResult()
     start_round = int(state.round)
@@ -137,13 +165,20 @@ def run_experiment(
     if cfg.checkpoint_dir and cfg.checkpoint_every:
         from distributed_active_learning_tpu.runtime import checkpoint as ckpt_lib
 
-        restored = ckpt_lib.restore_latest(cfg.checkpoint_dir, state, result)
+        ckpt_fp = ckpt_lib.config_fingerprint(cfg)
+        restored = ckpt_lib.restore_latest(
+            cfg.checkpoint_dir, state, result, fingerprint=ckpt_fp
+        )
         if restored is not None:
             state, result = restored
+            if mesh is not None:
+                from distributed_active_learning_tpu.parallel import shard_pool_state
+
+                state = shard_pool_state(state, mesh)  # re-place restored arrays
             start_round = int(state.round)
             dbg.debug(f"resumed at round {start_round}")
 
-    n_pool = state.n_pool
+    n_pool = state.n_valid  # real rows only; padding is never selectable
     round_idx = start_round
     while True:
         n_labeled = int(state_lib.labeled_count(state))
@@ -160,7 +195,7 @@ def run_experiment(
             packed = fit_forest_classifier(lx, ly, cfg.forest, seed=cfg.seed + round_idx)
             # One representation conversion per fit; the round + accuracy then
             # run on the configured kernel (MXU GEMM by default).
-            forest = forest_eval.for_kernel(packed, cfg.forest.kernel)
+            forest = place_forest(forest_eval.for_kernel(packed, cfg.forest.kernel))
         train_time = dbg.records[-1][1]
 
         with dbg.phase("round"):
@@ -189,7 +224,7 @@ def run_experiment(
         if cfg.checkpoint_dir and cfg.checkpoint_every and round_idx % cfg.checkpoint_every == 0:
             from distributed_active_learning_tpu.runtime import checkpoint as ckpt_lib
 
-            ckpt_lib.save(cfg.checkpoint_dir, state, result)
+            ckpt_lib.save(cfg.checkpoint_dir, state, result, fingerprint=ckpt_fp)
 
     if cfg.results_path:
         result.save(cfg.results_path, fmt="reference")

@@ -33,10 +33,23 @@ class PoolState:
     labeled_mask: jnp.ndarray  # [n] bool
     key: jax.Array             # PRNG key threaded through rounds
     round: jnp.ndarray         # scalar int32 round counter
+    # Number of real pool rows; -1 means "all". Rows past this are mesh-
+    # divisibility padding (see pad_for_sharding): marked labeled so selection
+    # never picks them, and masked out of every real-point statistic via
+    # valid_mask. Static (not a pytree leaf) so jitted rounds specialize on it.
+    n_valid_static: int = struct.field(pytree_node=False, default=-1)
 
     @property
     def n_pool(self) -> int:
         return self.x.shape[0]
+
+    @property
+    def n_valid(self) -> int:
+        return self.n_pool if self.n_valid_static < 0 else self.n_valid_static
+
+    @property
+    def valid_mask(self) -> jnp.ndarray:
+        return jnp.arange(self.n_pool) < self.n_valid
 
     @property
     def unlabeled_mask(self) -> jnp.ndarray:
@@ -48,7 +61,10 @@ class PoolState:
 
 
 def labeled_count(state: PoolState) -> jnp.ndarray:
-    return jnp.sum(state.labeled_mask.astype(jnp.int32))
+    """Number of *real* labeled points (padding rows never count)."""
+    if state.n_valid == state.n_pool:
+        return jnp.sum(state.labeled_mask.astype(jnp.int32))
+    return jnp.sum((state.labeled_mask & state.valid_mask).astype(jnp.int32))
 
 
 def unlabeled_count(state: PoolState) -> jnp.ndarray:
@@ -114,6 +130,28 @@ def set_start_state(state: PoolState, n_start: int, n_classes: int = 2) -> PoolS
         mask = mask.at[extra_idx].set(True)
 
     return state.replace(labeled_mask=mask, key=key)
+
+
+def pad_for_sharding(state: PoolState, multiple: int) -> PoolState:
+    """Pad the pool to a row count divisible by ``multiple`` (a mesh data-axis
+    size), so ``shard_map``/GSPMD kernels see equal blocks per device.
+
+    Padding rows carry zero features and ``labeled_mask=True``: the masked
+    top-k can never select them (selection runs over ``~labeled_mask``), the
+    density mass counts only unlabeled rows, and every real-point statistic
+    (labeled_count, LAL's f_3/f_8) filters through ``valid_mask``. The real
+    row count is recorded statically in ``n_valid_static``.
+    """
+    n = state.n_pool
+    pad = (-n) % multiple
+    if pad == 0:
+        return state
+    return state.replace(
+        x=jnp.pad(state.x, ((0, pad), (0, 0))),
+        oracle_y=jnp.pad(state.oracle_y, (0, pad)),
+        labeled_mask=jnp.pad(state.labeled_mask, (0, pad), constant_values=True),
+        n_valid_static=n,
+    )
 
 
 def reveal(state: PoolState, picked_idx: jnp.ndarray) -> PoolState:

@@ -44,7 +44,9 @@ def lal_features(forest: forest_eval.Forest, state: PoolState) -> jnp.ndarray:
     f1 = votes / forest.n_trees
     f2 = scoring.vote_sd(votes, forest.n_trees)
 
-    labeled = state.labeled_mask.astype(jnp.float32)
+    # valid_mask filters mesh-padding rows (marked labeled) out of the
+    # labeled-set statistics; a no-op on unpadded pools.
+    labeled = (state.labeled_mask & state.valid_mask).astype(jnp.float32)
     n_labeled = jnp.sum(labeled)
     # proportion of positive labels among labeled points (:286-289)
     f3 = jnp.sum(labeled * (state.oracle_y == 1)) / jnp.maximum(n_labeled, 1.0)

@@ -1,0 +1,3 @@
+"""Shared host-side utilities."""
+
+from distributed_active_learning_tpu.utils.io import atomic_savez

@@ -1,0 +1,29 @@
+"""Atomic file writes for checkpoints and persisted models.
+
+The reference writes models straight to HDFS (``save_regression_model.py:29``)
+and relies on HDFS rename semantics; the local equivalent is a temp file in
+the target directory published with ``os.replace`` so readers never observe a
+half-written npz.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+
+import numpy as np
+
+
+def atomic_savez(path: str, **payload) -> str:
+    """``np.savez(path, **payload)`` with write-to-temp + atomic rename."""
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, **payload)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    return path

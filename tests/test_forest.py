@@ -125,3 +125,88 @@ def test_deep_tree_budget_guard(blobs):
     model.fit(x, y)
     with pytest.raises(ValueError, match="budget"):
         pack_sklearn_forest(model, node_budget=3)
+
+
+def test_forest_save_load_roundtrip(tmp_path):
+    """Disk persistence (the reference's HDFS model save/load,
+    save_regression_model.py:29-33) must be bit-exact."""
+    import numpy as np
+    import jax.numpy as jnp
+    from distributed_active_learning_tpu.config import ForestConfig
+    from distributed_active_learning_tpu.models.forest import fit_forest_classifier
+    from distributed_active_learning_tpu.models.forest_io import load_forest, save_forest
+    from distributed_active_learning_tpu.ops.trees import predict_proba
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(200, 5)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.int32)
+    forest = fit_forest_classifier(x, y, ForestConfig(n_trees=6, max_depth=4))
+    path = str(tmp_path / "forest.npz")
+    save_forest(path, forest, meta="test-meta")
+    back, meta = load_forest(path)
+    assert meta == "test-meta"
+    assert back.max_depth == forest.max_depth
+    np.testing.assert_array_equal(np.asarray(back.feature), np.asarray(forest.feature))
+    np.testing.assert_allclose(
+        np.asarray(predict_proba(back, jnp.asarray(x))),
+        np.asarray(predict_proba(forest, jnp.asarray(x))),
+    )
+
+
+def test_load_or_train_trains_once(tmp_path):
+    """try-load-else-train (save_regression_model.py:28-34): second call loads
+    from disk instead of retraining."""
+    import numpy as np
+    from distributed_active_learning_tpu.config import ForestConfig
+    from distributed_active_learning_tpu.models.forest import fit_forest_regressor
+    from distributed_active_learning_tpu.models.forest_io import load_or_train
+
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(100, 3)).astype(np.float32)
+    t = x[:, 0].astype(np.float32)
+    calls = []
+
+    def train():
+        calls.append(1)
+        return fit_forest_regressor(x, t, ForestConfig(n_trees=4, max_depth=3))
+
+    path = str(tmp_path / "m" / "reg.npz")
+    a = load_or_train(path, train)
+    b = load_or_train(path, train)
+    assert len(calls) == 1
+    np.testing.assert_array_equal(np.asarray(a.feature), np.asarray(b.feature))
+
+
+def test_lal_regressor_model_path_survives_cache_reset(tmp_path, monkeypatch):
+    """lal_model_path persists the fitted regressor across 'process restarts'
+    (simulated by clearing the in-memory cache): the second call must load,
+    not retrain — and changed options must retrain, not reuse stale weights."""
+    import numpy as np
+    from distributed_active_learning_tpu.models import lal_training
+
+    calls = []
+    real_train = lal_training.train_lal_regressor
+
+    def counting_train(*a, **kw):
+        calls.append(1)
+        return real_train(*a, **kw)
+
+    monkeypatch.setattr(lal_training, "train_lal_regressor", counting_train)
+    opts = {
+        "lal_model_path": str(tmp_path / "lal.npz"),
+        "lal_experiments": 3,
+        "lal_trees": 4,
+        "lal_depth": 3,
+    }
+    a = lal_training.load_or_train_lal_regressor(opts)
+    assert len(calls) == 1
+    lal_training._CACHE.clear()
+    b = lal_training.load_or_train_lal_regressor(opts)
+    assert len(calls) == 1  # loaded from disk, no refit
+    np.testing.assert_array_equal(np.asarray(a.feature), np.asarray(b.feature))
+
+    # Different options against the same path: stale file must NOT be reused.
+    lal_training._CACHE.clear()
+    c = lal_training.load_or_train_lal_regressor({**opts, "lal_trees": 6})
+    assert len(calls) == 2
+    assert c.n_trees == 6

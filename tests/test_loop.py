@@ -129,5 +129,33 @@ def test_checkpoint_pool_size_mismatch_raises(tmp_path):
         checkpoint_dir=ckpt,
         checkpoint_every=1,
     )
-    with pytest.raises(ValueError, match="pool size"):
+    with pytest.raises(ValueError, match="fingerprint|pool size"):
+        run_experiment(bad)
+
+
+def test_checkpoint_kernel_switch_resumes(tmp_path):
+    """The evaluation kernel is performance-only (kernels agree bit-for-bit),
+    so resuming a gemm checkpoint with kernel='gather' must work."""
+    ckpt = os.path.join(tmp_path, "ckpt")
+    run_experiment(_cfg(max_rounds=1, checkpoint_dir=ckpt, checkpoint_every=1))
+    other = ExperimentConfig(
+        data=DataConfig(name="checkerboard2x2", seed=3),
+        forest=ForestConfig(n_trees=10, max_depth=4, kernel="gather"),
+        strategy=StrategyConfig(name="uncertainty", window_size=20),
+        n_start=10,
+        max_rounds=1,
+        checkpoint_dir=ckpt,
+        checkpoint_every=1,
+    )
+    res = run_experiment(other)
+    assert res.records[-1].round == 2  # continued, not refused
+
+
+def test_checkpoint_strategy_mismatch_raises(tmp_path):
+    """Same pool, different strategy: the config fingerprint must refuse the
+    resume (round-1 gap: only the pool size was guarded)."""
+    ckpt = os.path.join(tmp_path, "ckpt")
+    run_experiment(_cfg(max_rounds=1, checkpoint_dir=ckpt, checkpoint_every=1))
+    bad = _cfg(strategy="random", max_rounds=1, checkpoint_dir=ckpt, checkpoint_every=1)
+    with pytest.raises(ValueError, match="fingerprint"):
         run_experiment(bad)

@@ -109,3 +109,69 @@ def test_sharded_round_output_stays_sharded(devices, setup):
     # mask must not have collapsed to a single device
     sh = new_state.labeled_mask.sharding
     assert not sh.is_fully_replicated
+
+
+def test_sharded_experiment_matches_single_device():
+    """run_experiment with a 4x2 MeshConfig and a non-divisible pool (250 rows
+    padded to 252) must produce the same curve as the single-device run — the
+    sharding is a placement decision, not a semantic one."""
+    from distributed_active_learning_tpu.config import (
+        DataConfig,
+        ExperimentConfig,
+        MeshConfig,
+    )
+    from distributed_active_learning_tpu.runtime.loop import run_experiment
+
+    def cfg(mesh):
+        return ExperimentConfig(
+            data=DataConfig(name="checkerboard2x2", n_samples=250, seed=2),
+            forest=ForestConfig(n_trees=8, max_depth=4),
+            strategy=StrategyConfig(name="uncertainty", window_size=10),
+            mesh=mesh,
+            n_start=10,
+            max_rounds=3,
+            seed=7,
+        )
+
+    single = run_experiment(cfg(MeshConfig()))
+    sharded = run_experiment(cfg(MeshConfig(data=4, model=2)))
+    assert [r.n_labeled for r in sharded.records] == [r.n_labeled for r in single.records]
+    np.testing.assert_allclose(
+        [r.accuracy for r in sharded.records],
+        [r.accuracy for r in single.records],
+        atol=1e-6,
+    )
+
+
+def test_shard_pool_state_rejects_non_divisible():
+    from distributed_active_learning_tpu.runtime.state import pad_for_sharding
+
+    x, y = make_checkerboard(jax.random.key(2), 250)
+    state = init_pool_state(x, y, jax.random.key(3))
+    mesh = make_mesh(data=4, model=2)
+    with pytest.raises(ValueError, match="not divisible"):
+        shard_pool_state(state, mesh)
+    padded = pad_for_sharding(state, 4)
+    assert padded.n_pool == 252 and padded.n_valid == 250
+    sh = shard_pool_state(padded, mesh)
+    assert int(labeled_count(sh)) == 0  # padding rows don't count as labeled
+
+
+def test_mesh_model_axis_must_divide_trees():
+    from distributed_active_learning_tpu.config import (
+        DataConfig,
+        ExperimentConfig,
+        MeshConfig,
+    )
+    from distributed_active_learning_tpu.runtime.loop import run_experiment
+
+    cfg = ExperimentConfig(
+        data=DataConfig(name="checkerboard2x2", n_samples=64, seed=0),
+        forest=ForestConfig(n_trees=5, max_depth=3),
+        strategy=StrategyConfig(name="uncertainty", window_size=4),
+        mesh=MeshConfig(data=4, model=2),
+        n_start=6,
+        max_rounds=1,
+    )
+    with pytest.raises(ValueError, match="not divisible"):
+        run_experiment(cfg)
