@@ -23,13 +23,19 @@ from distributed_active_learning_tpu.data import formats, scaler, synthetic
 
 
 class DataBundle(NamedTuple):
-    """Dense train/test arrays for one AL experiment."""
+    """Dense train/test arrays for one AL experiment.
 
-    train_x: np.ndarray  # [n, d] float32
+    ``train_x`` is ``[n, d] float32`` for tabular pools, ``[n, H, W, C]``
+    float32 for image pools (cifar10), or ``[n, T] int32`` token ids for text
+    pools (agnews; ``vocab_size`` set).
+    """
+
+    train_x: np.ndarray
     train_y: np.ndarray  # [n] int32 — the oracle's labels, revealed via the mask
-    test_x: np.ndarray   # [m, d] float32
+    test_x: np.ndarray
     test_y: np.ndarray   # [m] int32
     name: str = ""
+    vocab_size: Optional[int] = None  # token pools only
 
     @property
     def n_pool(self) -> int:
@@ -114,6 +120,34 @@ def _xor(cfg: DataConfig) -> DataBundle:
     return _synth(cfg, synthetic.make_xor, 10000, 2000, "xor", d=10)
 
 
+def _register_file_checkerboard(base: str) -> None:
+    """Registry entries for the reference's committed fixture files
+    (``lal_direct_mllib_implementation/data/<base>_{train,test}.txt``, loaded
+    by the reference at ``classes/dataset.py:149-238``). ``cfg.path`` is the
+    directory holding them; parsing is byte-compatible ``load_labeled_text``.
+    These run curve-for-curve parity against the reference's own data, vs the
+    synthetic twins above."""
+
+    @register_dataset(f"{base}_file")
+    def _loader(cfg: DataConfig, base: str = base) -> DataBundle:
+        import os
+
+        if cfg.path is None:
+            raise ValueError(f"{base}_file dataset needs cfg.path (fixture directory)")
+        train_x, train_y = formats.load_labeled_text(
+            os.path.join(cfg.path, f"{base}_train.txt")
+        )
+        test_x, test_y = formats.load_labeled_text(
+            os.path.join(cfg.path, f"{base}_test.txt")
+        )
+        bundle = DataBundle(train_x, train_y, test_x, test_y, f"{base}_file")
+        return _standardize(bundle, cfg)
+
+
+for _base in ("checkerboard2x2", "checkerboard4x4", "rotated_checkerboard2x2"):
+    _register_file_checkerboard(_base)
+
+
 @register_dataset("striatum")
 def _striatum(cfg: DataConfig) -> DataBundle:
     """Label-last whitespace text files, -1 remapped to 0 (dataset.py:245-273).
@@ -143,6 +177,74 @@ def _credit_card(cfg: DataConfig) -> DataBundle:
     tr, te = perm[:split], perm[split:]
     bundle = DataBundle(x[tr], y[tr], x[te], y[te], "credit_card_fraud")
     return _standardize(bundle, cfg)
+
+
+@register_dataset("cifar10")
+def _cifar10(cfg: DataConfig) -> DataBundle:
+    """CIFAR-10 image pool (BASELINE.json config 4: CIFAR-10, small CNN).
+
+    With ``cfg.path``: loads the standard python-pickle batches directory
+    (``cifar-10-batches-py`` with data_batch_1..5 + test_batch), scaled to
+    zero-mean unit-ish range. Without a path: a synthetic stand-in at the
+    exact shape/dtype (32x32x3 float32, 10 classes) so the CNN pipeline is
+    exercisable anywhere — documented stand-in, not real CIFAR.
+    """
+    if cfg.path is not None:
+        import os
+        import pickle
+
+        def load_batch(fn):
+            with open(os.path.join(cfg.path, fn), "rb") as f:
+                d = pickle.load(f, encoding="bytes")
+            x = d[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+            return x.astype(np.float32) / 127.5 - 1.0, np.asarray(
+                d[b"labels"], dtype=np.int32
+            )
+        xs, ys = zip(*[load_batch(f"data_batch_{i}") for i in range(1, 6)])
+        train_x, train_y = np.concatenate(xs), np.concatenate(ys)
+        test_x, test_y = load_batch("test_batch")
+        return DataBundle(train_x, train_y, test_x, test_y, "cifar10")
+    k_tr, k_te = jax.random.split(jax.random.key(cfg.seed))
+    from distributed_active_learning_tpu.data.synthetic import make_synthetic_images
+
+    tx, ty = make_synthetic_images(k_tr, 2000)
+    ex, ey = make_synthetic_images(k_te, 500)
+    return DataBundle(
+        np.asarray(tx), np.asarray(ty), np.asarray(ex), np.asarray(ey), "cifar10"
+    )
+
+
+@register_dataset("agnews")
+def _agnews(cfg: DataConfig) -> DataBundle:
+    """AG-News token pool (BASELINE.json config 5: AG-News, encoder, BatchBALD).
+
+    With ``cfg.path``: a directory holding ``train.csv``/``test.csv`` in the
+    AG-News format ('"class","title","description"', class 1..4), hashed to
+    token ids (data/text.py). Without a path: a synthetic topic pool at the
+    exact shape ([n, 64] int32 ids, 4 classes).
+    """
+    vocab, max_len = 4096, 64
+    if cfg.path is not None:
+        import os
+
+        from distributed_active_learning_tpu.data.text import load_agnews_csv
+
+        train_x, train_y = load_agnews_csv(
+            os.path.join(cfg.path, "train.csv"), vocab, max_len
+        )
+        test_x, test_y = load_agnews_csv(
+            os.path.join(cfg.path, "test.csv"), vocab, max_len
+        )
+        return DataBundle(train_x, train_y, test_x, test_y, "agnews", vocab_size=vocab)
+    from distributed_active_learning_tpu.data.synthetic import make_synthetic_tokens
+
+    k_tr, k_te = jax.random.split(jax.random.key(cfg.seed))
+    tx, ty = make_synthetic_tokens(k_tr, 2000, vocab_size=vocab, max_len=max_len)
+    ex, ey = make_synthetic_tokens(k_te, 500, vocab_size=vocab, max_len=max_len)
+    return DataBundle(
+        np.asarray(tx), np.asarray(ty), np.asarray(ex), np.asarray(ey),
+        "agnews", vocab_size=vocab,
+    )
 
 
 @register_dataset("gaussian_unbalanced")

@@ -113,3 +113,48 @@ def make_random_matrix(key: jax.Array, n: int, d: int) -> jnp.ndarray:
     """Dense random matrix like ``sqgen.py`` (vectors_50000x1000.txt) /
     ``cosine_similarity.py:26`` (3000x500 random vectors)."""
     return jax.random.uniform(key, (n, d), dtype=jnp.float32)
+
+
+def make_synthetic_images(
+    key: jax.Array, n: int, n_classes: int = 10, hw: int = 32, channels: int = 3
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """CIFAR-shaped stand-in pool: ``[n, hw, hw, c] float32`` + labels.
+
+    Each class is a smooth random "prototype" image (low-frequency pattern per
+    class) plus per-sample noise, so a small CNN can genuinely learn the task
+    while shapes/dtypes match CIFAR-10 exactly (BASELINE.json config 4). Used
+    when no local CIFAR files are supplied — the real batches load via
+    data/datasets.py:cifar10 with cfg.path.
+    """
+    k_proto, k_noise, k_lab = jax.random.split(key, 3)
+    # low-frequency prototypes: upsampled 4x4 random patterns
+    coarse = jax.random.normal(k_proto, (n_classes, 4, 4, channels))
+    protos = jax.image.resize(coarse, (n_classes, hw, hw, channels), "bilinear")
+    y = jax.random.randint(k_lab, (n,), 0, n_classes)
+    noise = 0.6 * jax.random.normal(k_noise, (n, hw, hw, channels))
+    x = protos[y] + noise
+    return x.astype(jnp.float32), y.astype(jnp.int32)
+
+
+def make_synthetic_tokens(
+    key: jax.Array,
+    n: int,
+    n_classes: int = 4,
+    vocab_size: int = 4096,
+    max_len: int = 64,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """AG-News-shaped stand-in pool: ``[n, max_len] int32`` token ids + labels.
+
+    Each class draws tokens from its own slice of the vocabulary (plus shared
+    "stopword" ids), giving a learnable topic-classification signal at the
+    exact shape of the hashed AG-News pipeline (data/text.py).
+    """
+    k_lab, k_tok, k_stop, k_mix = jax.random.split(key, 4)
+    y = jax.random.randint(k_lab, (n,), 0, n_classes)
+    span = (vocab_size - 1) // n_classes
+    lo = 1 + y[:, None] * span
+    topic = lo + jax.random.randint(k_tok, (n, max_len), 0, span)
+    stop = 1 + jax.random.randint(k_stop, (n, max_len), 0, vocab_size - 1)
+    is_topic = jax.random.uniform(k_mix, (n, max_len)) < 0.7
+    ids = jnp.where(is_topic, topic, stop)
+    return ids.astype(jnp.int32), y.astype(jnp.int32)

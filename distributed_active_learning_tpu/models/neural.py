@@ -31,7 +31,10 @@ class SmallCNN(nn.Module):
     """Compact conv net for CIFAR-shaped inputs (BASELINE.json config 4).
 
     Conv-BN-free (batch statistics interact badly with tiny AL labeled sets);
-    dropout doubles as the MC posterior for BALD/BatchBALD.
+    dropout doubles as the MC posterior for BALD/BatchBALD. Downsampling is a
+    stride-2 conv, not pooling: reduce-window + its select-and-scatter grad
+    compile pathologically on some XLA backends and map worse onto the MXU
+    than a plain strided conv contraction.
     """
 
     n_classes: int = 10
@@ -42,9 +45,8 @@ class SmallCNN(nn.Module):
         for feats in (32, 64):
             x = nn.Conv(feats, (3, 3))(x)
             x = nn.relu(x)
-            x = nn.Conv(feats, (3, 3))(x)
+            x = nn.Conv(feats, (3, 3), strides=(2, 2))(x)
             x = nn.relu(x)
-            x = nn.avg_pool(x, (2, 2), strides=(2, 2))
             x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
         x = x.reshape((x.shape[0], -1))
         x = nn.Dense(128)(x)

@@ -46,6 +46,7 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--budget", type=int, default=None, help="stop at N labeled")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--out", default=None, help="write reference-format results log")
+    ap.add_argument("--plot", default=None, help="save accuracy/time curves as PNG")
     ap.add_argument("--checkpoint-dir", default=None)
     ap.add_argument("--checkpoint-every", type=int, default=0)
     # Device mesh for the sharded round (1x1 = single device). Pool rows ride
@@ -58,9 +59,19 @@ def build_parser() -> argparse.ArgumentParser:
     # Neural (deep-AL) mode: a neural learner over the pool with MC-dropout
     # acquisition. Selected by --neural or a "deep.*"-namespaced strategy name.
     ap.add_argument("--neural", action="store_true", help="use the neural-learner path")
+    ap.add_argument(
+        "--model", choices=["auto", "mlp", "cnn", "transformer"], default="auto",
+        help="neural learner (auto: cnn for image pools, transformer for "
+        "token pools, mlp for tabular)",
+    )
     ap.add_argument("--train-steps", type=int, default=200)
     ap.add_argument("--mc-samples", type=int, default=8)
     ap.add_argument("--hidden", default="128,64", help="MLP hidden sizes (neural mode)")
+    # Transformer encoder size (--model transformer)
+    ap.add_argument("--d-model", type=int, default=128)
+    ap.add_argument("--n-layers", type=int, default=2)
+    ap.add_argument("--n-heads", type=int, default=4)
+    ap.add_argument("--d-ff", type=int, default=256)
     return ap
 
 
@@ -149,9 +160,16 @@ def main(argv=None) -> int:
 
 
 def _run_neural(args, dbg):
-    """Deep-AL CLI path: MLP + MC-dropout over a (flattened) registry dataset."""
+    """Deep-AL CLI path: a neural learner + MC-dropout over a registry dataset.
+
+    Model selection covers BASELINE.json configs 4-5: ``--dataset cifar10
+    --model cnn`` (SmallCNN over image pools) and ``--dataset agnews --model
+    transformer`` (encoder over token-id pools); ``mlp`` serves tabular pools.
+    """
+    import numpy as np
+
     from distributed_active_learning_tpu.data import get_dataset
-    from distributed_active_learning_tpu.models.neural import MLP, NeuralLearner
+    from distributed_active_learning_tpu.models.neural import MLP, NeuralLearner, SmallCNN
     from distributed_active_learning_tpu.runtime.neural_loop import (
         NeuralExperimentConfig,
         run_neural_experiment,
@@ -160,11 +178,50 @@ def _run_neural(args, dbg):
     bundle = get_dataset(
         DataConfig(name=args.dataset, path=args.data_path, n_samples=args.n_samples, seed=args.seed)
     )
-    n_classes = int(bundle.train_y.max()) + 1
-    hidden = tuple(int(h) for h in args.hidden.split(",") if h)
+    n_classes = max(int(bundle.train_y.max()) + 1, 2)
+
+    kind = args.model
+    if kind == "auto":
+        if bundle.train_x.ndim == 4:
+            kind = "cnn"
+        elif np.issubdtype(np.asarray(bundle.train_x).dtype, np.integer):
+            kind = "transformer"
+        else:
+            kind = "mlp"
+
+    if kind == "cnn":
+        if bundle.train_x.ndim != 4:
+            raise ValueError(f"--model cnn needs an image pool, got shape {bundle.train_x.shape}")
+        module = SmallCNN(n_classes=n_classes)
+        input_shape = bundle.train_x.shape[1:]
+    elif kind == "transformer":
+        from distributed_active_learning_tpu.models.transformer import TransformerClassifier
+
+        if bundle.train_x.ndim != 2:
+            raise ValueError(f"--model transformer needs a token pool, got shape {bundle.train_x.shape}")
+        max_len = bundle.train_x.shape[1]
+        vocab = bundle.vocab_size or int(np.asarray(bundle.train_x).max()) + 1
+        module = TransformerClassifier(
+            vocab_size=vocab, max_len=max_len, n_classes=n_classes,
+            d_model=args.d_model, n_layers=args.n_layers,
+            n_heads=args.n_heads, d_ff=args.d_ff,
+        )
+        input_shape = (max_len,)
+    else:
+        hidden = tuple(int(h) for h in args.hidden.split(",") if h)
+        module = MLP(n_classes=n_classes, hidden=hidden)
+        if bundle.train_x.ndim > 2:
+            # flatten image pools for the MLP baseline
+            flat = int(np.prod(bundle.train_x.shape[1:]))
+            bundle = bundle._replace(
+                train_x=np.asarray(bundle.train_x).reshape(len(bundle.train_x), flat),
+                test_x=np.asarray(bundle.test_x).reshape(len(bundle.test_x), flat),
+            )
+        input_shape = (bundle.train_x.shape[1],)
+
     learner = NeuralLearner(
-        MLP(n_classes=max(n_classes, 2), hidden=hidden),
-        (bundle.n_features,),
+        module,
+        input_shape,
         train_steps=args.train_steps,
         mc_samples=args.mc_samples,
     )
@@ -189,6 +246,10 @@ def _emit(args, result, dbg):
         sys.stdout.write(result.to_reference_log())
     if args.out:
         result.save(args.out, fmt="reference")
+    if args.plot:
+        from distributed_active_learning_tpu.runtime.results import plot_result
+
+        plot_result(result, args.plot, title=f"{args.dataset} / {args.strategy}")
     if result.final_accuracy is not None and not args.quiet:
         print(
             f"# final: {result.records[-1].n_labeled} labeled, "

@@ -81,3 +81,38 @@ def parse_reference_log(text: str) -> ExperimentResult:
                 )
             )
     return result
+
+
+def plot_result(result: ExperimentResult, path: str, title: str = "") -> str:
+    """Save the experiment's curves as a PNG — the reference's per-run
+    matplotlib artifact (``classes/active_learner.py:369-384`` plots
+    per-iteration wall-clock and saves ``alrandom_first.png``). Two panels:
+    accuracy vs labeled count (the curve the results logs tabulate) and
+    per-round time (the reference's plotted quantity).
+    """
+    import matplotlib
+
+    matplotlib.use("Agg")  # headless
+    import matplotlib.pyplot as plt
+
+    labels = [r.n_labeled for r in result.records]
+    accs = [r.accuracy * 100 for r in result.records]
+    times = [r.total_time for r in result.records]
+
+    fig, (ax1, ax2) = plt.subplots(1, 2, figsize=(10, 4))
+    ax1.plot(labels, accs, marker="o", ms=3)
+    ax1.set_xlabel("labeled points")
+    ax1.set_ylabel("test accuracy (%)")
+    ax1.set_title("accuracy vs labels")
+    ax1.grid(True, alpha=0.3)
+    ax2.plot(range(1, len(times) + 1), times, marker="o", ms=3, color="tab:orange")
+    ax2.set_xlabel("iteration")
+    ax2.set_ylabel("round time (s)")
+    ax2.set_title("per-iteration time")
+    ax2.grid(True, alpha=0.3)
+    if title:
+        fig.suptitle(title)
+    fig.tight_layout()
+    fig.savefig(path, dpi=120)
+    plt.close(fig)
+    return path

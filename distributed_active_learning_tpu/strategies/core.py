@@ -59,6 +59,23 @@ def _uncertainty(cfg: StrategyConfig) -> Strategy:
     return Strategy(name="uncertainty", score=score, higher_is_better=False)
 
 
+@register_strategy("soft_uncertainty")
+def _soft_uncertainty(cfg: StrategyConfig) -> Strategy:
+    """Least-confidence over the *mean leaf probability* instead of the hard
+    per-tree vote fraction. The reference's hard votes
+    (``uncertainty_sampling.py:96``) quantize p to n_trees+1 levels, flooding
+    the top-k with ties at small forests; the soft posterior keeps the same
+    ranking rule (distance from 0.5, ascending) with full resolution. A
+    capability improvement beyond parity — ``uncertainty`` stays the exact
+    reference formula."""
+
+    def score(forest, state, key, aux):
+        del key, aux
+        return scoring.uncertainty_score(forest_eval.proba(forest, state.x))
+
+    return Strategy(name="soft_uncertainty", score=score, higher_is_better=False)
+
+
 @register_strategy("entropy")
 def _entropy(cfg: StrategyConfig) -> Strategy:
     """The reference's one-sided entropy ``-(1-p)·log2(1-p)``

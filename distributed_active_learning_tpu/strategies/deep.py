@@ -19,6 +19,7 @@ the BatchBALD greedy loop, whose trip count ``k`` is static per window size.
 
 from __future__ import annotations
 
+import functools
 from typing import Tuple
 
 import jax
@@ -66,6 +67,7 @@ def _joint_entropy_candidates(joint: jnp.ndarray, probs: jnp.ndarray) -> jnp.nda
     return -jnp.sum(q * jnp.log(q + _EPS), axis=(1, 2))
 
 
+@functools.partial(jax.jit, static_argnums=(2, 3, 4))
 def batchbald_select(
     probs_samples: jnp.ndarray,
     unlabeled_mask: jnp.ndarray,
@@ -73,7 +75,12 @@ def batchbald_select(
     max_configs: int = 4096,
     candidate_pool: int = 512,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Greedy BatchBALD batch of ``k`` points.
+    """Greedy BatchBALD batch of ``k`` points — one compiled selection.
+
+    The greedy loop is *unrolled under jit*: the joint's config count at pick
+    ``t`` is the static ``C^t``, so every iteration has static shapes and the
+    exact→marginal-BALD fallback branch (``C^t > max_configs``) resolves at
+    trace time. One XLA launch replaces k host-driven rounds of device ops.
 
     Memory plan: the greedy joint is evaluated only over the top
     ``candidate_pool`` unlabeled points by marginal BALD (standard practice —

@@ -91,3 +91,14 @@ def test_cli_neural_checkpoint_flags_rejected():
             "--rounds", "1", "--quiet", "--checkpoint-dir", "/tmp/nope",
             "--checkpoint-every", "1",
         ])
+
+
+def test_cli_plot_writes_png(tmp_path):
+    out = tmp_path / "curve.png"
+    rc = main([
+        "--dataset", "checkerboard2x2", "--strategy", "random", "--window", "30",
+        "--rounds", "2", "--quiet", "--plot", str(out),
+    ])
+    assert rc == 0
+    data = out.read_bytes()
+    assert data[:8] == b"\x89PNG\r\n\x1a\n" and len(data) > 1000

@@ -131,3 +131,33 @@ def test_unknown_deep_strategy_raises():
             np.zeros(5, np.int32),
         )
     assert "deep.batchbald" in available_deep_strategies()
+
+
+def test_batchbald_jitted_matches_eager(key):
+    """batchbald_select is one compiled selection; it must agree with the
+    uncompiled trace (jax.disable_jit) pick for pick."""
+    p = jax.nn.softmax(jax.random.normal(key, (4, 60, 2)) * 2.0, axis=-1)
+    unlabeled = jnp.arange(60) % 5 != 0
+    picked_jit, scores_jit = deep.batchbald_select(p, unlabeled, k=6, max_configs=64)
+    with jax.disable_jit():
+        picked_eager, scores_eager = deep.batchbald_select(p, unlabeled, k=6, max_configs=64)
+    np.testing.assert_array_equal(np.asarray(picked_jit), np.asarray(picked_eager))
+    np.testing.assert_allclose(np.asarray(scores_jit), np.asarray(scores_eager), atol=1e-5)
+
+
+def test_batchbald_window16_exact_to_fallback_boundary(key):
+    """With C=2 and max_configs=64 the joint is exact through pick 6 (2^6=64)
+    and falls back to marginal BALD for picks 7..16 — all 16 picks must be
+    distinct, unlabeled, and returned in one compiled call."""
+    p = jax.nn.softmax(jax.random.normal(key, (5, 120, 2)) * 1.5, axis=-1)
+    unlabeled = jnp.ones(120, bool).at[:7].set(False)
+    picked, scores = deep.batchbald_select(p, unlabeled, k=16, max_configs=64)
+    picked = np.asarray(picked)
+    assert len(set(picked.tolist())) == 16
+    assert (picked >= 7).all()
+    # fallback picks (7+) are ranked by marginal BALD among remaining candidates
+    bald = np.asarray(deep.bald_score(p))
+    chosen = set(picked[:7].tolist())
+    remaining = [i for i in range(120) if i >= 7 and i not in chosen]
+    expected_8th = max(remaining, key=lambda i: bald[i])
+    assert picked[7] == expected_8th
