@@ -52,6 +52,17 @@ inline bool is_blank_line(const char* p, const char* end) {
   return true;
 }
 
+// strtof accepts C99 hex-float tokens ("0x1A") that the numpy fallback
+// rejects; acceptance must not depend on whether the .so is built, so any
+// token containing 'x'/'X' is a parse error here too. ("inf"/"nan" are
+// accepted by both parsers and stay allowed.)
+inline bool has_hex_marker(const char* p, const char* end) {
+  for (; p != end; ++p) {
+    if (*p == 'x' || *p == 'X') return true;
+  }
+  return false;
+}
+
 // Parse one line's fields into out (appending). Returns field count, or -1 on
 // a token that fails to parse as a float (or, in CSV mode, an empty field).
 // With out == nullptr only counts tokens (no strtof) — the cheap
@@ -71,6 +82,7 @@ long parse_line(const char* p, const char* end, bool csv, std::vector<float>* ou
       while (fe > f && (fe[-1] == ' ' || fe[-1] == '\t' || fe[-1] == '"')) --fe;
       if (f == fe) return -1;  // empty field
       if (out) {
+        if (has_hex_marker(f, fe)) return -1;
         char* next = nullptr;
         float v = std::strtof(f, &next);
         if (next != fe) return -1;  // not a single clean float token
@@ -90,17 +102,23 @@ long parse_line(const char* p, const char* end, bool csv, std::vector<float>* ou
       ++p;
     }
     if (p >= end || is_line_break(*p)) break;
+    // One token-end scan serves both passes: the count-only pass advances by
+    // it, the parse pass hex-checks the same span — so count and parse always
+    // agree on token boundaries.
+    const char* te = p;
+    while (te < end && !is_line_break(*te) && *te != ' ' && *te != '\t' &&
+           *te != '"') {
+      ++te;
+    }
     if (out) {
+      if (has_hex_marker(p, te)) return -1;
       char* next = nullptr;
       float v = std::strtof(p, &next);
       if (next == p) return -1;
       out->push_back(v);
       p = next;
     } else {
-      while (p < end && !is_line_break(*p) && *p != ' ' && *p != '\t' &&
-             *p != '"') {
-        ++p;
-      }
+      p = te;
     }
     ++count;
   }

@@ -83,8 +83,17 @@ def load_or_train(
             forest, stored_meta = load_forest(path)
             if meta is None or stored_meta == meta:
                 return forest
-        except (ValueError, KeyError, OSError):
-            pass  # corrupt/old file: retrain and overwrite
+        except (ValueError, KeyError) as e:
+            # Corrupt/old-format file: retrain and overwrite — but say so, the
+            # cached model is about to be destroyed. OSError (permissions, IO)
+            # propagates: it signals an environment problem, and retraining
+            # over it would clobber a possibly-healthy file.
+            import warnings
+
+            warnings.warn(
+                f"stored forest at {path} unreadable ({e}); retraining",
+                stacklevel=2,
+            )
     forest = train_fn()
     save_forest(path, forest, meta=meta)
     return forest

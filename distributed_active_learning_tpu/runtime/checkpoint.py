@@ -127,6 +127,17 @@ def restore_latest(
             f"checkpoint config fingerprint {stored_fp} != current experiment "
             f"{fingerprint}: refusing to resume a different experiment's state"
         )
+    if fingerprint is not None and stored_fp is None:
+        # Pre-fingerprint checkpoints carry no identity record, so the
+        # config-mismatch guard cannot apply — say so instead of silently
+        # resuming whatever experiment wrote the file.
+        import warnings
+
+        warnings.warn(
+            f"resuming unfingerprinted checkpoint alstate_{step}.npz: the "
+            "config-mismatch guard did not apply",
+            stacklevel=2,
+        )
     if mask.shape != state.labeled_mask.shape:
         raise ValueError(
             f"checkpoint pool size {mask.shape} != experiment pool {state.labeled_mask.shape}"

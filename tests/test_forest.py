@@ -177,6 +177,28 @@ def test_load_or_train_trains_once(tmp_path):
     np.testing.assert_array_equal(np.asarray(a.feature), np.asarray(b.feature))
 
 
+def test_load_or_train_corrupt_file_warns_and_retrains(tmp_path):
+    """A corrupt cache file is retrained over — with a warning, not silently
+    (the load failure would otherwise destroy the cached model unexplained)."""
+    import numpy as np
+    import pytest
+    from distributed_active_learning_tpu.config import ForestConfig
+    from distributed_active_learning_tpu.models.forest import fit_forest_regressor
+    from distributed_active_learning_tpu.models.forest_io import load_or_train
+
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(50, 3)).astype(np.float32)
+    t = x[:, 0].astype(np.float32)
+    path = str(tmp_path / "reg.npz")
+    with open(path, "wb") as f:
+        f.write(b"not an npz")
+    with pytest.warns(UserWarning, match="unreadable"):
+        forest = load_or_train(
+            path, lambda: fit_forest_regressor(x, t, ForestConfig(n_trees=2, max_depth=2))
+        )
+    assert forest.feature.shape[0] == 2
+
+
 def test_lal_regressor_model_path_survives_cache_reset(tmp_path, monkeypatch):
     """lal_model_path persists the fitted regressor across 'process restarts'
     (simulated by clearing the in-memory cache): the second call must load,

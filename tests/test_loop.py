@@ -151,6 +151,24 @@ def test_checkpoint_kernel_switch_resumes(tmp_path):
     assert res.records[-1].round == 2  # continued, not refused
 
 
+def test_checkpoint_unfingerprinted_resume_warns(tmp_path):
+    """Pre-fingerprint checkpoints can't be identity-checked; resuming one
+    must say so instead of silently skipping the guard."""
+    from distributed_active_learning_tpu.runtime import checkpoint as ckpt_lib
+    from distributed_active_learning_tpu.runtime import state as state_lib
+
+    ckpt = os.path.join(tmp_path, "ckpt")
+    state = state_lib.init_pool_state(
+        np.zeros((20, 2), np.float32), np.zeros(20, np.int32), jax.random.key(0)
+    )
+    ckpt_lib.save(ckpt, state, ExperimentResult())  # no fingerprint (old format)
+    with pytest.warns(UserWarning, match="unfingerprinted"):
+        restored = ckpt_lib.restore_latest(
+            ckpt, state, ExperimentResult(), fingerprint="abc123"
+        )
+    assert restored is not None
+
+
 def test_checkpoint_strategy_mismatch_raises(tmp_path):
     """Same pool, different strategy: the config fingerprint must refuse the
     resume (round-1 gap: only the pool size was guarded)."""

@@ -83,6 +83,30 @@ def test_native_rejects_comma_only_line(tmp_path):
     assert _native._parse(str(p), is_csv=True) is None
 
 
+def test_native_rejects_hex_float_tokens(tmp_path):
+    """strtof accepts C99 hex floats ('0x1A'); the numpy fallback raises on
+    them, so the native path must reject them too (acceptance parity)."""
+    p = tmp_path / "hex.txt"
+    p.write_text("1.0 0x1A 2.0\n3.0 4.0 5.0\n")
+    assert _native.try_load_matrix(str(p), None) is None
+    pc = tmp_path / "hex.csv"
+    pc.write_text("a,b\n1.0,0x1A\n")
+    assert _native._parse(str(pc), is_csv=True) is None
+
+
+def test_native_accepts_inf_nan_like_fallback(tmp_path):
+    """'inf'/'nan' parse on both paths — only hex is a divergence."""
+    p = tmp_path / "special.txt"
+    p.write_text("inf nan\n-inf 1.0\n")
+    native = _native.try_load_matrix(str(p), None)
+    assert native is not None
+    oracle = np.loadtxt(p, dtype=np.float32)
+    np.testing.assert_array_equal(np.isnan(native), np.isnan(oracle))
+    np.testing.assert_array_equal(
+        native[~np.isnan(native)], oracle[~np.isnan(oracle)]
+    )
+
+
 def test_load_labeled_text_uses_native(tmp_path):
     p = tmp_path / "striatum.txt"
     p.write_text("0.5 1.25 -1\n1.0 2.0 1\n")
