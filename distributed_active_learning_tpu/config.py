@@ -34,6 +34,16 @@ class ForestConfig:
     # Deep forests (max_depth > 10) automatically use "gather" (the path
     # matrix grows O(4^depth); see ops.forest_eval.for_kernel).
     kernel: str = "gemm"
+    # Where the forest is *trained*: "host" fits sklearn on the labeled subset
+    # (the JVM-fit equivalent); "device" runs the jitted histogram trainer
+    # (ops/trees_train.py) — level-wise binned splits like MLlib itself, with
+    # the whole round (fit + score + select) staying on the TPU. Device fit
+    # uses ``max_bins`` as its histogram resolution.
+    fit: str = "host"
+    # Static row capacity of the device trainer's labeled window (None = grow
+    # to the experiment's label cap). Fixed per experiment so the jitted fit
+    # never recompiles as labels accumulate.
+    fit_budget: Optional[int] = None
     # Static node budget per tree for the packed representation. A binary tree of
     # depth D has at most 2^(D+1) - 1 nodes; loaders assert fit.
     node_budget: Optional[int] = None

@@ -1,0 +1,322 @@
+"""On-device random-forest training: histogram splits, level-wise, under jit.
+
+The reference trains its forests in the JVM (``RandomForest.trainClassifier``,
+``final_thesis/uncertainty_sampling.py:71-76``) — which is itself a *binned*
+histogram trainer (MLlib's ``maxBins=32`` is exactly the bin count passed
+there). This module is the TPU-native equivalent (SURVEY.md §7 "hard parts"):
+the one component that previously still ran on a non-TPU substrate (host
+sklearn) in the AL hot loop.
+
+Design — everything is static-shape and jit-friendly:
+
+- **Binning**: per-feature quantile edges computed once per experiment from the
+  pool; features become int32 codes in ``[0, n_bins)``. ``code <= b`` is
+  equivalent to ``x <= edges[b]`` (searchsorted-left), so trained splits
+  transfer to raw-feature inference exactly.
+- **Level-wise complete trees in heap layout**: every tree is grown to the full
+  ``max_depth`` with node ``v``'s children at ``2v+1``/``2v+2``. Pure or empty
+  nodes keep splitting degenerately — their descendants inherit the node value,
+  which predicts identically to early stopping but keeps every shape static.
+- **Histogram build as MXU matmuls**: per level, per-(node, class) one-hot
+  row weights ``A [m, J*C]`` against the shared one-hot binned features
+  ``B [m, d*n_bins]`` gives all class histograms for all nodes of the level in
+  one batched GEMM — the vectorized replacement for MLlib's per-executor
+  histogram aggregation + driver reduce.
+- **Bootstrap** via Poisson(1) row weights (the standard multinomial
+  approximation), **feature subsampling** per node (``sqrt(d)`` like
+  MLlib's 'auto'/sklearn default) via masked gains.
+- **Split criterion**: weighted Gini impurity decrease (``'gini'``,
+  ``uncertainty_sampling.py:75``).
+
+Because the trees are complete, the GEMM path-matrix form (``ops/trees_gemm``)
+has *data-independent* structure: :func:`heap_gemm_forest` builds a
+:class:`GemmForest` by slicing — no host round-trip — so fit + convert +
+score + select can run as one jitted program.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from distributed_active_learning_tpu.ops.trees import LEAF, PackedForest
+from distributed_active_learning_tpu.ops.trees_gemm import GemmForest
+
+
+@struct.dataclass
+class BinnedPool:
+    """Per-feature quantile binning of a (pool) matrix.
+
+    ``edges [d, n_bins-1]`` are ascending boundaries; ``codes [n, d] int32``
+    satisfy ``codes <= b  <=>  x <= edges[:, b]``.
+    """
+
+    edges: jnp.ndarray  # [d, n_bins - 1] float32
+    codes: jnp.ndarray  # [n, d] int32
+
+    @property
+    def n_bins(self) -> int:
+        return self.edges.shape[1] + 1
+
+
+def make_bins(x: jnp.ndarray, n_bins: int = 32) -> BinnedPool:
+    """Quantile-bin the pool once per experiment (MLlib finds its candidate
+    splits the same way, on a sample of the input)."""
+    x = jnp.asarray(x, jnp.float32)
+    qs = jnp.linspace(0.0, 1.0, n_bins + 1)[1:-1]  # interior quantiles
+    edges = jnp.quantile(x, qs, axis=0).T  # [d, n_bins-1]
+    codes = code_features(x, edges)
+    return BinnedPool(edges=edges, codes=codes)
+
+
+def code_features(x: jnp.ndarray, edges: jnp.ndarray) -> jnp.ndarray:
+    """Map raw features to bin codes: ``code = #{edges < x}`` (so that
+    ``code <= b <=> x <= edges[b]``)."""
+    # Per-feature binary search — no [n, d, n_bins] broadcast intermediate
+    # (the benchmark pool is 284,807 x 30; a dense compare would transiently
+    # cost ~0.5 GB just to bin it).
+    return jax.vmap(
+        lambda e, col: jnp.searchsorted(e, col, side="left"), in_axes=(0, 1), out_axes=1
+    )(edges, x).astype(jnp.int32)
+
+
+def _gini_gain(
+    left: jnp.ndarray, parent: jnp.ndarray
+) -> jnp.ndarray:
+    """Weighted Gini impurity decrease for every candidate split.
+
+    ``left [..., C, S]``: class counts routed left per split candidate;
+    ``parent [..., C]``: the node's class counts. Returns ``[..., S]`` gains
+    scaled by the parent weight (the constant factor does not change the
+    argmax; it avoids dividing by tiny node weights).
+    """
+    right = parent[..., :, None] - left
+    wl = jnp.sum(left, axis=-2)
+    wr = jnp.sum(right, axis=-2)
+    w = jnp.sum(parent, axis=-1)[..., None]
+    # sum_c n_c^2 / w  (safe at w == 0)
+    def _purity(counts, weight):
+        return jnp.sum(counts * counts, axis=-2) / jnp.maximum(weight, 1e-9)
+
+    child = _purity(left, wl) + _purity(right, wr)
+    parent_purity = jnp.sum(parent * parent, axis=-1)[..., None] / jnp.maximum(w, 1e-9)
+    # gain * w = (child purity sum) - (parent purity); >= 0, 0 for pure/empty.
+    return child - parent_purity
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n_trees", "max_depth", "n_bins", "tree_chunk")
+)
+def fit_forest_device(
+    codes: jnp.ndarray,     # [m, d] int32 — binned rows (the fit window)
+    y: jnp.ndarray,         # [m] int32 in {0, 1}
+    weights: jnp.ndarray,   # [m] float32 — 0 for invalid/unlabeled rows
+    edges: jnp.ndarray,     # [d, n_bins - 1] float32
+    key: jax.Array,
+    n_trees: int,
+    max_depth: int,
+    n_bins: int = 32,
+    tree_chunk: int = 16,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Train ``n_trees`` complete depth-``max_depth`` trees on device.
+
+    Returns heap-layout arrays ``(feature [T, I], threshold [T, I],
+    value [T, 2^(D+1)-1])`` where ``I = 2^D - 1`` internal nodes precede the
+    ``2^D`` leaves; node ``v``'s children are ``2v+1``/``2v+2``.
+    """
+    m, d = codes.shape
+    D = max_depth
+    C = 2
+    n_feat_sub = max(int(np.ceil(np.sqrt(d))), 1)
+
+    # Shared one-hot binned features [m, d * n_bins] — built once per fit.
+    bmat = (
+        (codes[:, :, None] == jnp.arange(n_bins)[None, None, :])
+        .reshape(m, d * n_bins)
+        .astype(jnp.bfloat16)
+    )
+    y1 = (y == 1)
+
+    def fit_chunk(args):
+        k_chunk = args
+        Tc = tree_chunk
+        k_boot, k_feat = jax.random.split(k_chunk)
+        # Poisson(1) bootstrap weights, zeroed outside the labeled window.
+        w = jax.random.poisson(k_boot, 1.0, (Tc, m)).astype(jnp.float32)
+        w = w * weights[None, :]
+        wy = jnp.stack([w * (~y1), w * y1], axis=2)  # [Tc, m, C]
+
+        node = jnp.zeros((Tc, m), dtype=jnp.int32)  # level-local node index
+        feat_out = []
+        thr_out = []
+        values = [
+            jnp.sum(wy, axis=1)[:, None, :]  # [Tc, 1, C] root counts
+        ]
+
+        for level in range(D):
+            J = 1 << level
+            # One-hot (node, class) row weights [Tc, m, J*C].
+            a = (node[:, :, None] == jnp.arange(J)[None, None, :])  # [Tc, m, J]
+            a = (a[:, :, :, None] * wy[:, :, None, :]).reshape(Tc, m, J * C)
+            # All histograms of the level in one batched GEMM:
+            # [Tc, J*C, m] x [m, d*n_bins] -> [Tc, J*C, d*n_bins].
+            hist = jax.lax.dot_general(
+                a.astype(jnp.bfloat16),
+                bmat,
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ).reshape(Tc, J, C, d, n_bins)
+
+            parent = values[level]  # [Tc, J, C] — counts computed a level up
+            # Left counts for split-at-bin-b: prefix sums over bins.
+            left = jnp.cumsum(hist, axis=4)[..., : n_bins - 1]  # [Tc,J,C,d,B-1]
+            n_splits = d * (n_bins - 1)
+            gain = _gini_gain(left.reshape(Tc, J, C, n_splits), parent)
+            gain = gain.reshape(Tc, J, d, n_bins - 1)
+            # Mask features outside the node's random subset (sqrt(d) of them).
+            k_lvl = jax.random.fold_in(k_feat, level)
+            scores = jax.random.uniform(k_lvl, (Tc, J, d))
+            kth = jax.lax.top_k(scores, n_feat_sub)[0][..., -1]
+            fmask = scores >= kth[..., None]  # exactly n_feat_sub True per node
+            gain = jnp.where(fmask[..., None], gain, -jnp.inf)
+
+            best = jnp.argmax(gain.reshape(Tc, J, n_splits), axis=2)  # [Tc, J]
+            bf = (best // (n_bins - 1)).astype(jnp.int32)  # feature id
+            bb = (best % (n_bins - 1)).astype(jnp.int32)   # split bin
+            feat_out.append(bf)
+            thr_out.append(edges[bf, bb])
+
+            # Children class counts from the chosen split.
+            left_best = jnp.take_along_axis(
+                left.reshape(Tc, J, C, -1),
+                (bf * (n_bins - 1) + bb)[:, :, None, None],
+                axis=3,
+            )[..., 0]  # [Tc, J, C]
+            right_best = parent - left_best
+            children = jnp.stack([left_best, right_best], axis=2).reshape(
+                Tc, 2 * J, C
+            )
+            values.append(children)
+
+            # Route rows: left iff code[row, feat*] <= bin*.
+            feat_pt = jnp.take_along_axis(bf, node, axis=1)  # [Tc, m]
+            bin_pt = jnp.take_along_axis(bb, node, axis=1)
+            code_pt = codes[jnp.arange(m)[None, :], feat_pt]  # [Tc, m]
+            go_left = code_pt <= bin_pt
+            node = 2 * node + jnp.where(go_left, 0, 1)
+
+        # Heap-order internal arrays: level l occupies [2^l - 1, 2^(l+1) - 1).
+        feature = jnp.concatenate(feat_out, axis=1)      # [Tc, 2^D - 1]
+        threshold = jnp.concatenate(thr_out, axis=1)     # [Tc, 2^D - 1]
+        # Node values: P(class 1), empty nodes inherit the parent value.
+        vals = []
+        root = values[0]
+        root_v = root[..., 1] / jnp.maximum(root.sum(-1), 1e-9)  # [Tc, 1]
+        vals.append(root_v)
+        for level in range(1, D + 1):
+            cnt = values[level]  # [Tc, 2^level, C]
+            tot = cnt.sum(-1)
+            v = cnt[..., 1] / jnp.maximum(tot, 1e-9)
+            parent_v = jnp.repeat(vals[level - 1], 2, axis=1)
+            vals.append(jnp.where(tot > 0, v, parent_v))
+        value = jnp.concatenate(vals, axis=1)  # [Tc, 2^(D+1) - 1]
+        return feature, threshold, value
+
+    n_chunks = -(-n_trees // tree_chunk)
+    keys = jax.random.split(key, n_chunks)
+    feature, threshold, value = jax.lax.map(fit_chunk, keys)
+    merge = lambda t: t.reshape(-1, t.shape[-1])[:n_trees]
+    return merge(feature), merge(threshold), merge(value)
+
+
+def heap_packed_forest(
+    feature: jnp.ndarray, threshold: jnp.ndarray, value: jnp.ndarray, max_depth: int
+) -> PackedForest:
+    """Wrap heap-layout trained arrays as a :class:`PackedForest` (gather
+    kernel compatible; children of ``v`` at ``2v+1``/``2v+2``)."""
+    T, I = feature.shape
+    n_nodes = 2 * I + 1  # 2^(D+1) - 1
+    node = jnp.arange(n_nodes, dtype=jnp.int32)
+    internal = node < I
+    full_feature = jnp.concatenate(
+        [feature, jnp.full((T, n_nodes - I), LEAF, jnp.int32)], axis=1
+    )
+    full_threshold = jnp.concatenate(
+        [threshold, jnp.zeros((T, n_nodes - I), jnp.float32)], axis=1
+    )
+    left = jnp.where(internal, 2 * node + 1, node)
+    right = jnp.where(internal, 2 * node + 2, node)
+    return PackedForest(
+        feature=full_feature,
+        threshold=full_threshold,
+        left=jnp.broadcast_to(left, (T, n_nodes)),
+        right=jnp.broadcast_to(right, (T, n_nodes)),
+        value=value.astype(jnp.float32),
+        max_depth=max_depth,
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _heap_path_target(depth: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Static path matrix/targets of the complete depth-``depth`` heap tree.
+
+    ``path [I, L]`` is +1/-1/0 as in :class:`GemmForest`; ``target [L]`` is the
+    leaf's left-ancestor count. Data-independent, so device-fit forests convert
+    to GEMM form by slicing (no host round-trip, unlike
+    ``gemm_forest_from_packed``).
+    """
+    I = (1 << depth) - 1
+    L = 1 << depth
+    path = np.zeros((I, L), dtype=np.float32)
+    target = np.zeros((L,), dtype=np.float32)
+    for l in range(L):
+        node = I + l  # heap id of the leaf
+        while node > 0:
+            parent = (node - 1) // 2
+            went_left = node == 2 * parent + 1
+            path[parent, l] = 1.0 if went_left else -1.0
+            target[l] += float(went_left)
+            node = parent
+    return path, target
+
+
+def heap_gemm_forest(
+    feature: jnp.ndarray, threshold: jnp.ndarray, value: jnp.ndarray, max_depth: int
+) -> GemmForest:
+    """Build the MXU path-matrix form of a device-fit (complete-heap) forest.
+
+    Pure slicing + a static constant — jit-friendly, so the full AL round
+    (fit + convert + score + select) compiles into one XLA program.
+    """
+    T, I = feature.shape
+    L = I + 1
+    path_np, target_np = _heap_path_target(max_depth)
+    leaf_value = value[:, I:]  # leaves occupy the heap tail
+    return GemmForest(
+        feat_ids=feature,
+        thresholds=threshold,
+        path=jnp.broadcast_to(jnp.asarray(path_np), (T, I, L)),
+        target=jnp.broadcast_to(jnp.asarray(target_np), (T, L)),
+        value=leaf_value.astype(jnp.float32),
+    )
+
+
+def gather_fit_window(
+    codes: jnp.ndarray, y: jnp.ndarray, mask: jnp.ndarray, budget: int
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Pack the labeled rows into a fixed-size window on device.
+
+    The labeled set grows every round; gathering it into a static
+    ``budget``-row buffer (surplus rows weighted 0) keeps the jitted fit from
+    recompiling — the mask-not-shapes rule of SURVEY.md §7 applied to training.
+    """
+    n = codes.shape[0]
+    order = jnp.argsort(~mask)  # stable: labeled rows first, in index order
+    idx = order[:budget]
+    sel = mask[idx]
+    return codes[idx], y[idx], sel.astype(jnp.float32)

@@ -89,6 +89,49 @@ def _labeled_subset(
     return x, y
 
 
+def _resolve_fit_budget(cfg: ExperimentConfig, n_pool: int, n_labeled: int) -> int:
+    """Static row capacity for the device trainer's labeled window.
+
+    Defaults to the experiment's label cap (the starting labeled count plus
+    all windows, or the label budget plus one overshooting window) so the
+    jitted fit compiles once and never truncates. ``n_labeled`` is the count
+    at loop start — after a checkpoint restore it exceeds ``n_start``, and
+    ``max_rounds`` grants that many *further* rounds.
+    """
+    if cfg.forest.fit_budget is not None:
+        return min(cfg.forest.fit_budget, n_pool)
+    caps = [n_pool]
+    if cfg.label_budget is not None:
+        caps.append(cfg.label_budget + cfg.strategy.window_size)
+    if cfg.max_rounds is not None:
+        caps.append(n_labeled + cfg.max_rounds * cfg.strategy.window_size)
+    return min(caps)
+
+
+def make_device_fit(cfg: ExperimentConfig, edges: jnp.ndarray, budget: int):
+    """Jitted device train phase: labeled-window gather + histogram fit +
+    kernel-form conversion, all in one XLA program (no host round-trip —
+    the replacement for the JVM fit at ``uncertainty_sampling.py:71-76``)."""
+    from distributed_active_learning_tpu.ops import trees_train
+
+    fc = cfg.forest
+    to_gemm = fc.kernel == "gemm" and fc.max_depth <= forest_eval._GEMM_MAX_DEPTH
+
+    @jax.jit
+    def fit(codes: jnp.ndarray, state: state_lib.PoolState, key: jax.Array):
+        mask = state.labeled_mask & state.valid_mask
+        c, yy, w = trees_train.gather_fit_window(codes, state.oracle_y, mask, budget)
+        f, th, v = trees_train.fit_forest_device(
+            c, yy, w, edges, key,
+            n_trees=fc.n_trees, max_depth=fc.max_depth, n_bins=fc.max_bins,
+        )
+        if to_gemm:
+            return trees_train.heap_gemm_forest(f, th, v, fc.max_depth)
+        return trees_train.heap_packed_forest(f, th, v, fc.max_depth)
+
+    return fit
+
+
 def build_aux(cfg: ExperimentConfig, state: state_lib.PoolState) -> StrategyAux:
     """Assemble strategy aux inputs (LAL regressor, seed mask) from config."""
     lal_forest = None
@@ -159,6 +202,9 @@ def run_experiment(
 
     aux = build_aux(cfg, state)
 
+    if cfg.forest.fit not in ("host", "device"):
+        raise ValueError(f"unknown ForestConfig.fit {cfg.forest.fit!r}; use 'host' or 'device'")
+
     result = ExperimentResult()
     start_round = int(state.round)
 
@@ -178,6 +224,24 @@ def run_experiment(
             start_round = int(state.round)
             dbg.debug(f"resumed at round {start_round}")
 
+    # Device training path: bin the pool once; per round the fit is one jitted
+    # program over the masked labeled window (static shapes, no recompiles).
+    # Built after any checkpoint restore so the fit window's capacity accounts
+    # for the labels the resumed run already holds.
+    device_fit = None
+    if cfg.forest.fit == "device":
+        from distributed_active_learning_tpu.ops import trees_train
+
+        binned = trees_train.make_bins(jnp.asarray(host_x), cfg.forest.max_bins)
+        codes = binned.codes
+        if state.n_pool > codes.shape[0]:  # align with mesh padding rows
+            codes = jnp.pad(codes, ((0, state.n_pool - codes.shape[0]), (0, 0)))
+        fit_budget = _resolve_fit_budget(
+            cfg, state.n_valid, int(state_lib.labeled_count(state))
+        )
+        device_fit = make_device_fit(cfg, binned.edges, fit_budget)
+        fit_key = jax.random.key(cfg.seed + 0x5EED)
+
     n_pool = state.n_valid  # real rows only; padding is never selectable
     round_idx = start_round
     while True:
@@ -191,11 +255,22 @@ def run_experiment(
         round_idx += 1
 
         with dbg.phase("train"):
-            lx, ly = _labeled_subset(state, host_x, host_y)
-            packed = fit_forest_classifier(lx, ly, cfg.forest, seed=cfg.seed + round_idx)
-            # One representation conversion per fit; the round + accuracy then
-            # run on the configured kernel (MXU GEMM by default).
-            forest = place_forest(forest_eval.for_kernel(packed, cfg.forest.kernel))
+            if device_fit is not None:
+                if n_labeled > fit_budget:
+                    raise ValueError(
+                        f"{n_labeled} labeled rows exceed the device fit "
+                        f"window ({fit_budget}); raise ForestConfig.fit_budget"
+                    )
+                forest = place_forest(
+                    device_fit(codes, state, jax.random.fold_in(fit_key, round_idx))
+                )
+                jax.block_until_ready(forest)  # keep phase timings honest
+            else:
+                lx, ly = _labeled_subset(state, host_x, host_y)
+                packed = fit_forest_classifier(lx, ly, cfg.forest, seed=cfg.seed + round_idx)
+                # One representation conversion per fit; the round + accuracy
+                # then run on the configured kernel (MXU GEMM by default).
+                forest = place_forest(forest_eval.for_kernel(packed, cfg.forest.kernel))
         train_time = dbg.records[-1][1]
 
         with dbg.phase("round"):

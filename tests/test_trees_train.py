@@ -1,0 +1,209 @@
+"""On-device histogram forest trainer (ops/trees_train.py).
+
+The reference's trainer is MLlib's binned, level-wise JVM fit
+(``RandomForest.trainClassifier`` with ``maxBins=32``,
+``final_thesis/uncertainty_sampling.py:71-76``); sklearn's exact-split fit is
+the host-side oracle these tests compare against.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_active_learning_tpu.config import (
+    DataConfig,
+    ExperimentConfig,
+    ForestConfig,
+    StrategyConfig,
+)
+from distributed_active_learning_tpu.data.synthetic import make_checkerboard
+from distributed_active_learning_tpu.models.forest import fit_forest_classifier
+from distributed_active_learning_tpu.ops import trees, trees_gemm, trees_train
+
+
+def _device_forest(x, y, w=None, n_trees=30, depth=8, n_bins=64, seed=0):
+    pool = trees_train.make_bins(jnp.asarray(x), n_bins)
+    if w is None:
+        w = jnp.ones(len(x), jnp.float32)
+    f, th, v = trees_train.fit_forest_device(
+        pool.codes, jnp.asarray(y), w, pool.edges, jax.random.key(seed),
+        n_trees=n_trees, max_depth=depth, n_bins=n_bins,
+    )
+    return f, th, v
+
+
+def _acc(proba, y):
+    return float(np.mean((np.asarray(proba) > 0.5) == np.asarray(y)))
+
+
+def test_binning_roundtrip_consistency():
+    """code <= b must be exactly equivalent to x <= edges[b] — trained split
+    bins transfer to raw-feature inference without drift."""
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(500, 4)).astype(np.float32))
+    pool = trees_train.make_bins(x, 16)
+    for b in (0, 7, 14):
+        lhs = pool.codes <= b
+        rhs = x <= pool.edges[:, b][None, :]
+        np.testing.assert_array_equal(np.asarray(lhs), np.asarray(rhs))
+
+
+def test_device_fit_accuracy_near_sklearn_checkerboard():
+    """SURVEY §7 hard-part acceptance: within ~2 points of the sklearn oracle."""
+    kx, kt = jax.random.split(jax.random.key(1))
+    x, y = make_checkerboard(kx, 1000)
+    tx, ty = make_checkerboard(kt, 1000)
+    f, th, v = _device_forest(np.asarray(x), np.asarray(y), n_trees=50)
+    packed = trees_train.heap_packed_forest(f, th, v, 8)
+    acc_dev = _acc(trees.predict_proba(packed, tx), ty)
+    sk = fit_forest_classifier(
+        np.asarray(x), np.asarray(y), ForestConfig(n_trees=50, max_depth=8)
+    )
+    acc_sk = _acc(trees.predict_proba(sk, tx), ty)
+    assert acc_dev >= acc_sk - 0.02, (acc_dev, acc_sk)
+
+
+def test_device_fit_accuracy_near_sklearn_fraud_shape():
+    """The credit-card-fraud workload shape (30 features, linear-ish signal)."""
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(3000, 30)).astype(np.float32)
+    y = (x[:, 0] + 0.3 * x[:, 1] > 0).astype(np.int32)
+    tx = rng.normal(size=(1500, 30)).astype(np.float32)
+    ty = (tx[:, 0] + 0.3 * tx[:, 1] > 0).astype(np.int32)
+    f, th, v = _device_forest(x, y, n_trees=50, n_bins=32)
+    packed = trees_train.heap_packed_forest(f, th, v, 8)
+    acc_dev = _acc(trees.predict_proba(packed, jnp.asarray(tx)), ty)
+    sk = fit_forest_classifier(x, y, ForestConfig(n_trees=50, max_depth=8))
+    acc_sk = _acc(trees.predict_proba(sk, jnp.asarray(tx)), ty)
+    assert acc_dev >= acc_sk - 0.02, (acc_dev, acc_sk)
+
+
+def test_heap_gemm_matches_gather_on_device_fit():
+    """The static-path GEMM conversion must agree with the gather traversal
+    on the same trained forest (same bit-for-bit contract as the host path)."""
+    kx, _ = jax.random.split(jax.random.key(3))
+    x, y = make_checkerboard(kx, 400)
+    f, th, v = _device_forest(np.asarray(x), np.asarray(y), n_trees=8, depth=5)
+    packed = trees_train.heap_packed_forest(f, th, v, 5)
+    gemm = trees_train.heap_gemm_forest(f, th, v, 5)
+    p_gather = trees.predict_proba(packed, x)
+    p_gemm = trees_gemm.predict_proba_gemm(gemm, x)
+    np.testing.assert_allclose(np.asarray(p_gather), np.asarray(p_gemm), atol=1e-6)
+
+
+def test_weights_confine_fit_to_labeled_rows():
+    """Rows with weight 0 must not influence the fit: training on (pool, mask)
+    equals training on the packed labeled window alone."""
+    rng = np.random.default_rng(4)
+    x = rng.normal(size=(300, 3)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.int32)
+    # Poison the unlabeled rows' labels; a leak would tank accuracy.
+    mask = np.zeros(300, dtype=bool)
+    mask[:80] = True
+    y_poison = y.copy()
+    y_poison[~mask] = 1 - y[~mask]
+    pool = trees_train.make_bins(jnp.asarray(x), 32)
+    c, yy, w = trees_train.gather_fit_window(
+        pool.codes, jnp.asarray(y_poison), jnp.asarray(mask), budget=128
+    )
+    assert int(w.sum()) == 80
+    f, th, v = trees_train.fit_forest_device(
+        c, yy, w, pool.edges, jax.random.key(0), n_trees=20, max_depth=6, n_bins=32
+    )
+    packed = trees_train.heap_packed_forest(f, th, v, 6)
+    acc = _acc(trees.predict_proba(packed, jnp.asarray(x[mask])), y[mask])
+    assert acc > 0.9, acc
+
+
+def test_gather_fit_window_budget_and_order():
+    mask = jnp.asarray([False, True, False, True, True, False])
+    codes = jnp.arange(12, dtype=jnp.int32).reshape(6, 2)
+    y = jnp.arange(6, dtype=jnp.int32)
+    c, yy, w = trees_train.gather_fit_window(codes, y, mask, budget=4)
+    # labeled rows (1, 3, 4) first in index order, then surplus with weight 0
+    np.testing.assert_array_equal(np.asarray(yy[:3]), [1, 3, 4])
+    np.testing.assert_array_equal(np.asarray(w), [1, 1, 1, 0])
+
+
+def test_pure_node_children_inherit_value():
+    """A pool where one side is pure after the root split: descendant leaves on
+    the pure side must predict the pure value (empty/pure nodes inherit)."""
+    x = np.concatenate([np.full((50, 1), -1.0), np.full((50, 1), 1.0)]).astype(np.float32)
+    x = x + np.random.default_rng(5).normal(scale=0.01, size=x.shape).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.int32)
+    f, th, v = _device_forest(x, y, n_trees=4, depth=4, n_bins=8)
+    packed = trees_train.heap_packed_forest(f, th, v, 4)
+    proba = np.asarray(trees.predict_proba(packed, jnp.asarray(x)))
+    np.testing.assert_allclose(proba[y == 0], 0.0, atol=1e-6)
+    np.testing.assert_allclose(proba[y == 1], 1.0, atol=1e-6)
+
+
+def test_run_experiment_with_device_fit():
+    """ForestConfig.fit='device' end-to-end: the AL loop runs and learns."""
+    cfg = ExperimentConfig(
+        data=DataConfig(name="checkerboard2x2", seed=3),
+        forest=ForestConfig(n_trees=10, max_depth=6, fit="device", max_bins=64),
+        strategy=StrategyConfig(name="uncertainty", window_size=30),
+        n_start=10,
+        max_rounds=5,
+        seed=0,
+    )
+    from distributed_active_learning_tpu.runtime.loop import run_experiment
+
+    res = run_experiment(cfg)
+    assert len(res.records) == 5
+    assert res.records[-1].accuracy > 0.8, [r.accuracy for r in res.records]
+
+
+def test_device_fit_rejects_unknown_fit_kind():
+    from distributed_active_learning_tpu.runtime.loop import run_experiment
+
+    cfg = ExperimentConfig(
+        data=DataConfig(name="checkerboard2x2"),
+        forest=ForestConfig(fit="quantum"),
+        max_rounds=1,
+    )
+    with pytest.raises(ValueError, match="ForestConfig.fit"):
+        run_experiment(cfg)
+
+
+def test_device_fit_checkpoint_resume_continues(tmp_path):
+    """Resuming a device-fit run must size the fit window from the RESTORED
+    labeled count (max_rounds grants further rounds past the checkpoint); a
+    budget computed from n_start alone would overflow and abort the resume."""
+    import os
+
+    from distributed_active_learning_tpu.runtime.loop import run_experiment
+
+    def _cfg():
+        return ExperimentConfig(
+            data=DataConfig(name="checkerboard2x2", seed=3),
+            forest=ForestConfig(n_trees=6, max_depth=4, fit="device"),
+            strategy=StrategyConfig(name="uncertainty", window_size=20),
+            n_start=10,
+            max_rounds=3,
+            checkpoint_dir=os.path.join(tmp_path, "ckpt"),
+            checkpoint_every=1,
+            seed=4,
+        )
+
+    first = run_experiment(_cfg())
+    assert len(first.records) == 3
+    resumed = run_experiment(_cfg())  # 3 MORE rounds from the checkpoint
+    assert [r.round for r in resumed.records] == [1, 2, 3, 4, 5, 6]
+    assert resumed.records[-1].n_labeled == 10 + 5 * 20
+
+
+def test_device_fit_budget_overflow_raises():
+    from distributed_active_learning_tpu.runtime.loop import run_experiment
+
+    cfg = ExperimentConfig(
+        data=DataConfig(name="checkerboard2x2", seed=3),
+        forest=ForestConfig(n_trees=4, max_depth=4, fit="device", fit_budget=16),
+        strategy=StrategyConfig(name="random", window_size=10),
+        n_start=10,
+        max_rounds=3,
+    )
+    with pytest.raises(ValueError, match="fit window"):
+        run_experiment(cfg)
