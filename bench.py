@@ -1,20 +1,31 @@
-"""Headline benchmark: acquisition-scoring throughput over the unlabeled pool.
+"""Headline benchmarks vs the reference's Spark cluster numbers.
 
-Workload (BASELINE.json config 1): the credit-card-fraud pool shape —
-284,807 x 30 features — scored by a 100-tree random forest with
-least-confidence uncertainty + window top-k, i.e. one full acquisition round's
-device work (``mllib/credit_card_fraud.py`` pool + ``uncertainty_sampling.py``
-strategy). The CSV itself is not redistributable, so features are synthesized
-at the same shape; tree traversal cost is shape-driven (feature values only
-steer branch directions), so throughput is representative.
+Three modes (BASELINE.md's two metric families + the LAL showcase):
 
-Baseline derivation (BASELINE.md): the reference's only persisted distributed
-scoring measurement is the LAL regressor pass — 2000 trees over a 1000-point
-pool in 616.87 s on the 8-executor Spark cluster (``classes/RESULTS.txt:17``)
-= 3,242 tree-point evals/s. At this workload's 100 trees/point that is
-~32.4 scores/s. The north-star target is >=50x (BASELINE.json).
+- ``score``  — acquisition-scoring throughput over the unlabeled pool
+  (BASELINE.json config 1): the credit-card-fraud pool shape, 284,807 x 30,
+  scored by a 100-tree forest with least-confidence + top-k. Reports MFU
+  (achieved FLOP/s over the chip's bf16 peak) alongside scores/s.
+- ``round``  — one full AL round's wall-clock: forest fit + score + select +
+  reveal on the same workload (the "AL-round wall-clock" family). Runs both
+  the on-device histogram fit and the host sklearn fit for comparison.
+- ``lal``    — the reference's recorded showcase: one LAL query on a
+  1000-point pool with a 50-tree base forest and a 2000-tree error-reduction
+  regressor, vs 1654.16 s/query on the 8-executor Spark cluster
+  (``classes/RESULTS.txt:20``; regressor pass alone 616.87 s, ``:17``).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Baseline derivations:
+
+- Spark scoring throughput: the only persisted distributed scoring measurement
+  is the LAL regressor pass — 2000 trees x 1000 points in 616.87 s
+  (``classes/RESULTS.txt:17``) = 3,242 tree-point evals/s. At 100 trees/point
+  that is ~32.4 scores/s; the north-star target is >=50x (BASELINE.json).
+- Spark round wall-clock: scoring the 284,807-point pool alone at that rate
+  costs 28.48M tree-points / 3,242/s = 8,784 s; fit/shuffle time would add
+  more, so using it as the round baseline is conservative.
+
+Default (no --mode) runs all three and prints ONE JSON line whose headline is
+the scoring metric, with the round/LAL/MFU numbers as additional keys.
 """
 
 import argparse
@@ -23,39 +34,64 @@ import time
 
 import numpy as np
 
-
-# 2000 trees * 1000 points / 616.87 s (classes/RESULTS.txt:17), at 100 trees.
+# 2000 trees * 1000 points / 616.87 s (classes/RESULTS.txt:17).
 SPARK_TREE_POINTS_PER_SEC = 2000 * 1000 / 616.87
+# One full LAL query (classes/RESULTS.txt:20, TOTAL TIME).
+SPARK_LAL_QUERY_SEC = 1654.16
+
+# Per-chip bf16 peak FLOP/s by jax device_kind (public spec sheets).
+_PEAK_BF16 = {
+    "TPU v2": 45e12,
+    "TPU v3": 123e12,
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
 
 
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--pool", type=int, default=284_807)  # credit-card fraud rows
-    ap.add_argument("--features", type=int, default=30)
-    ap.add_argument("--trees", type=int, default=100)  # mllib/credit_card_fraud.py:35
-    ap.add_argument("--depth", type=int, default=8)
-    ap.add_argument("--window", type=int, default=100)
-    ap.add_argument("--iters", type=int, default=10)
-    ap.add_argument("--train-rows", type=int, default=5000)
-    ap.add_argument(
-        "--kernel", choices=["gemm", "gather"], default="gemm",
-        help="forest evaluation kernel (gemm = MXU path-matrix form)",
-    )
-    args = ap.parse_args()
+def _peak_flops():
+    import jax
 
+    kind = jax.devices()[0].device_kind
+    for name, peak in _PEAK_BF16.items():
+        if kind.startswith(name):
+            return peak, kind
+    return None, kind
+
+
+def _median_time(fn, iters):
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        fn()
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times))
+
+
+def _make_pool(args, rng):
+    pool = rng.normal(size=(args.pool, args.features)).astype(np.float32)
+    train_x = rng.normal(size=(args.train_rows, args.features)).astype(np.float32)
+    train_y = (train_x[:, 0] + 0.3 * train_x[:, 1] > 0).astype(np.int32)
+    return pool, train_x, train_y
+
+
+def bench_score(args):
     import jax
     import jax.numpy as jnp
 
     from distributed_active_learning_tpu.config import ForestConfig
     from distributed_active_learning_tpu.models.forest import fit_forest_classifier
     from distributed_active_learning_tpu.ops import forest_eval
-    from distributed_active_learning_tpu.ops.topk import select_bottom_k
     from distributed_active_learning_tpu.ops.scoring import uncertainty_score
+    from distributed_active_learning_tpu.ops.topk import select_bottom_k
+    from distributed_active_learning_tpu.ops.trees_gemm import GemmForest
 
     rng = np.random.default_rng(0)
-    pool = rng.normal(size=(args.pool, args.features)).astype(np.float32)
-    train_x = rng.normal(size=(args.train_rows, args.features)).astype(np.float32)
-    train_y = (train_x[:, 0] + 0.3 * train_x[:, 1] > 0).astype(np.int32)
+    pool, train_x, train_y = _make_pool(args, rng)
 
     forest = forest_eval.for_kernel(
         fit_forest_classifier(
@@ -63,13 +99,10 @@ def main():
         ),
         args.kernel,
     )
-    # for_kernel falls back to gather past its depth cap — report what ran.
-    from distributed_active_learning_tpu.ops.trees_gemm import GemmForest
     kernel_used = "gemm" if isinstance(forest, GemmForest) else "gather"
     pool_dev = jax.device_put(jnp.asarray(pool))
     unlabeled = jnp.ones(args.pool, dtype=bool)
-
-    window = args.window  # closed over as a Python int -> static under jit
+    window = args.window
 
     @jax.jit
     def acquisition(forest, x, mask):
@@ -78,30 +111,243 @@ def main():
         vals, idx = select_bottom_k(scores, mask, window)
         return scores, idx
 
-    # Warmup / compile.
-    scores, idx = acquisition(forest, pool_dev, unlabeled)
-    jax.block_until_ready((scores, idx))
+    def run():
+        out = acquisition(forest, pool_dev, unlabeled)
+        jax.block_until_ready(out)
 
+    run()  # compile
     times = []
     for _ in range(args.iters):
         t0 = time.perf_counter()
-        scores, idx = acquisition(forest, pool_dev, unlabeled)
-        jax.block_until_ready((scores, idx))
+        run()
         times.append(time.perf_counter() - t0)
+    scores_per_sec = args.pool / min(times)
 
-    best = min(times)
-    scores_per_sec = args.pool / best
-    spark_scores_per_sec = SPARK_TREE_POINTS_PER_SEC / args.trees
-    print(
-        json.dumps(
-            {
-                "metric": "acquisition_scores_per_sec",
-                "value": round(scores_per_sec, 1),
-                "unit": f"scores/s ({args.pool}x{args.features} pool, {args.trees} trees, depth {args.depth}, {kernel_used} kernel)",
-                "vs_baseline": round(scores_per_sec / spark_scores_per_sec, 1),
-            }
+    result = {
+        "value": round(scores_per_sec, 1),
+        "vs_baseline": round(scores_per_sec / (SPARK_TREE_POINTS_PER_SEC / args.trees), 1),
+        "kernel": kernel_used,
+    }
+    if kernel_used == "gemm":
+        T, I = forest.feat_ids.shape
+        L = forest.value.shape[1]
+        flops_per_point = 2 * T * I * L + 2 * T * L
+        achieved = scores_per_sec * flops_per_point
+        peak, chip = _peak_flops()
+        result["achieved_tflops"] = round(achieved / 1e12, 2)
+        result["chip"] = chip
+        if peak:
+            result["mfu"] = round(achieved / peak, 4)
+    return result
+
+
+def bench_round(args):
+    """One full AL round: fit + score + select + reveal (device and host fit)."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_active_learning_tpu.config import ForestConfig
+    from distributed_active_learning_tpu.models.forest import fit_forest_classifier
+    from distributed_active_learning_tpu.ops import forest_eval, trees_train
+    from distributed_active_learning_tpu.ops.scoring import uncertainty_score
+    from distributed_active_learning_tpu.ops.topk import select_bottom_k
+
+    rng = np.random.default_rng(0)
+    pool, _, _ = _make_pool(args, rng)
+    pool_y = (pool[:, 0] + 0.3 * pool[:, 1] > 0).astype(np.int32)
+    n = args.pool
+    mask0 = np.zeros(n, dtype=bool)
+    mask0[rng.permutation(n)[: args.train_rows]] = True
+
+    pool_dev = jax.device_put(jnp.asarray(pool))
+    y_dev = jax.device_put(jnp.asarray(pool_y))
+    mask_dev = jax.device_put(jnp.asarray(mask0))
+    window = args.window
+    fc = ForestConfig(n_trees=args.trees, max_depth=args.depth)
+
+    @jax.jit
+    def score_select(forest, x, mask):
+        votes = forest_eval.votes(forest, x)
+        scores = uncertainty_score(votes.astype(jnp.float32) / forest.n_trees)
+        _, idx = select_bottom_k(scores, ~mask, window)
+        return mask.at[idx].set(True)
+
+    # --- device fit round: gather window + histogram fit + score, all on TPU.
+    binned = trees_train.make_bins(pool_dev, fc.max_bins)
+    budget = 1 << (args.train_rows + window - 1).bit_length()
+
+    # Same depth guard as the product path (forest_eval._GEMM_MAX_DEPTH): deep
+    # forests keep the gather traversal instead of a 4^depth path tensor.
+    to_gemm = fc.max_depth <= forest_eval._GEMM_MAX_DEPTH
+
+    @jax.jit
+    def device_round(codes, y, mask, key):
+        c, yy, w = trees_train.gather_fit_window(codes, y, mask, budget)
+        f, th, v = trees_train.fit_forest_device(
+            c, yy, w, binned.edges, key,
+            n_trees=fc.n_trees, max_depth=fc.max_depth, n_bins=fc.max_bins,
         )
+        if to_gemm:
+            forest = trees_train.heap_gemm_forest(f, th, v, fc.max_depth)
+        else:
+            forest = trees_train.heap_packed_forest(f, th, v, fc.max_depth)
+        return score_select(forest, pool_dev, mask)
+
+    key = jax.random.key(0)
+
+    def run_device():
+        jax.block_until_ready(device_round(binned.codes, y_dev, mask_dev, key))
+
+    run_device()  # compile
+    device_sec = _median_time(run_device, args.iters)
+
+    # --- host (sklearn) fit round: the round-2 status quo, for comparison.
+    def run_host():
+        lx, ly = pool[mask0], pool_y[mask0]
+        packed = fit_forest_classifier(lx, ly, fc)
+        forest = forest_eval.for_kernel(packed, "gemm")
+        jax.block_until_ready(score_select(forest, pool_dev, mask_dev))
+
+    run_host()  # compile
+    host_sec = _median_time(run_host, max(args.iters // 2, 1))
+
+    spark_round_sec = args.pool * args.trees / SPARK_TREE_POINTS_PER_SEC
+    return {
+        "round_seconds": round(device_sec, 4),
+        "round_seconds_host_fit": round(host_sec, 4),
+        "vs_baseline": round(spark_round_sec / device_sec, 1),
+        "spark_round_seconds_derived": round(spark_round_sec, 1),
+    }
+
+
+def bench_lal(args):
+    """One LAL query at reference scale: 50-tree base forest, 2000-tree
+    regressor, 1000-point pool (``classes/RESULTS.txt``)."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_active_learning_tpu.config import ForestConfig
+    from distributed_active_learning_tpu.models.forest import fit_forest_classifier
+    from distributed_active_learning_tpu.models.lal_training import (
+        generate_lal_dataset,
+        train_lal_regressor,
     )
+    from distributed_active_learning_tpu.ops import forest_eval
+    from distributed_active_learning_tpu.ops.topk import select_top_k
+    from distributed_active_learning_tpu.runtime import state as state_lib
+    from distributed_active_learning_tpu.strategies.lal import lal_features
+
+    # Setup (untimed; the reference also pretrains its regressor offline and
+    # loads it in 9.81 s, RESULTS.txt:5): synthesize a small LAL training set
+    # and fit the 2000-tree regressor at reference scale.
+    feats, targets = generate_lal_dataset(seed=0, n_experiments=20)
+    lal_forest = forest_eval.for_kernel(
+        train_lal_regressor(feats, targets, n_trees=args.lal_trees, max_depth=8),
+        "gemm",
+    )
+
+    rng = np.random.default_rng(0)
+    pool_x = rng.uniform(size=(args.lal_pool, 2)).astype(np.float32)
+    pool_y = (
+        (pool_x[:, 0] > 0.5).astype(np.int32) ^ (pool_x[:, 1] > 0.5).astype(np.int32)
+    )
+    state = state_lib.init_pool_state(pool_x, pool_y, jax.random.key(0))
+    state = state_lib.set_start_state(state, 100)
+    mask_host = np.asarray(state.labeled_mask)
+
+    base_cfg = ForestConfig(n_trees=50, max_depth=8)
+
+    @jax.jit
+    def lal_query(forest, lal_forest, state):
+        feats = lal_features(forest, state)
+        scores = forest_eval.value(lal_forest, feats)
+        _, picked = select_top_k(scores, ~state.labeled_mask, 1)
+        return state_lib.reveal(state, picked), scores
+
+    def run():
+        # Base-forest train (reference: 12.56 s) + feature build + 2000-tree
+        # regressor predict (616.87 s) + select + set update (833.48 s).
+        packed = fit_forest_classifier(
+            pool_x[mask_host], pool_y[mask_host], base_cfg
+        )
+        forest = forest_eval.for_kernel(packed, "gemm")
+        out = lal_query(forest, lal_forest, state)
+        jax.block_until_ready(out)
+
+    run()  # compile
+    sec = _median_time(run, args.iters)
+    return {
+        "lal_query_seconds": round(sec, 4),
+        "vs_baseline": round(SPARK_LAL_QUERY_SEC / sec, 1),
+        "lal_trees": args.lal_trees,
+        "spark_lal_query_seconds": SPARK_LAL_QUERY_SEC,
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mode", choices=["all", "score", "round", "lal"], default="all")
+    ap.add_argument("--pool", type=int, default=284_807)  # credit-card fraud rows
+    ap.add_argument("--features", type=int, default=30)
+    ap.add_argument("--trees", type=int, default=100)  # mllib/credit_card_fraud.py:35
+    ap.add_argument("--depth", type=int, default=8)
+    ap.add_argument("--window", type=int, default=100)
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--train-rows", type=int, default=5000)
+    ap.add_argument("--lal-trees", type=int, default=2000)  # active_learner.py:357
+    ap.add_argument("--lal-pool", type=int, default=1000)   # RESULTS.txt workload
+    ap.add_argument(
+        "--kernel", choices=["gemm", "gather"], default="gemm",
+        help="forest evaluation kernel (gemm = MXU path-matrix form)",
+    )
+    args = ap.parse_args()
+
+    if args.mode == "score":
+        r = bench_score(args)
+        print(json.dumps({
+            "metric": "acquisition_scores_per_sec",
+            "value": r["value"],
+            "unit": f"scores/s ({args.pool}x{args.features} pool, {args.trees} trees, depth {args.depth}, {r['kernel']} kernel)",
+            "vs_baseline": r["vs_baseline"],
+            **{k: v for k, v in r.items() if k not in ("value", "vs_baseline", "kernel")},
+        }))
+    elif args.mode == "round":
+        r = bench_round(args)
+        print(json.dumps({
+            "metric": "al_round_seconds",
+            "value": r["round_seconds"],
+            "unit": f"s/round (device fit + score + select, {args.pool} pool, {args.trees} trees)",
+            "vs_baseline": r["vs_baseline"],
+            "round_seconds_host_fit": r["round_seconds_host_fit"],
+            "spark_round_seconds_derived": r["spark_round_seconds_derived"],
+        }))
+    elif args.mode == "lal":
+        r = bench_lal(args)
+        print(json.dumps({
+            "metric": "lal_query_seconds",
+            "value": r["lal_query_seconds"],
+            "unit": f"s/query ({args.lal_pool} pool, 50-tree base, {args.lal_trees}-tree regressor)",
+            "vs_baseline": r["vs_baseline"],
+            "spark_lal_query_seconds": r["spark_lal_query_seconds"],
+        }))
+    else:
+        s = bench_score(args)
+        rd = bench_round(args)
+        ll = bench_lal(args)
+        print(json.dumps({
+            "metric": "acquisition_scores_per_sec",
+            "value": s["value"],
+            "unit": f"scores/s ({args.pool}x{args.features} pool, {args.trees} trees, depth {args.depth}, {s['kernel']} kernel)",
+            "vs_baseline": s["vs_baseline"],
+            "mfu": s.get("mfu"),
+            "achieved_tflops": s.get("achieved_tflops"),
+            "chip": s.get("chip"),
+            "round_seconds": rd["round_seconds"],
+            "round_seconds_host_fit": rd["round_seconds_host_fit"],
+            "round_vs_spark_derived": rd["vs_baseline"],
+            "lal_query_seconds": ll["lal_query_seconds"],
+            "lal_query_vs_spark": ll["vs_baseline"],
+        }))
 
 
 if __name__ == "__main__":

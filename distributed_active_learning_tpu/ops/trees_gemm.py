@@ -147,10 +147,25 @@ def _predict_chunk(gf: GemmForest, x: jnp.ndarray) -> jnp.ndarray:
     return pred
 
 
+def _auto_chunk(gf: GemmForest) -> int:
+    """Pool-axis chunk size bounding the ``[chunk, T, L]`` intermediates.
+
+    The compare/hit tensors scale with T*L; a fixed chunk would let deep/wide
+    forests (e.g. the 2000-tree LAL regressor) materialize multi-GB
+    intermediates and OOM the device. Cap them at ~512M elements (~2 GB f32),
+    power-of-two chunks for stable tiling.
+    """
+    T, L = gf.value.shape
+    budget = max(512 * 1024 * 1024 // (T * L), 256)
+    return min(1 << (budget.bit_length() - 1), 8192)
+
+
 def predict_leaves_gemm(
-    gf: GemmForest, x: jnp.ndarray, chunk: int = 8192
+    gf: GemmForest, x: jnp.ndarray, chunk: int | None = None
 ) -> jnp.ndarray:
     """Per-tree leaf values ``[n, T]`` via the MXU path, chunked over rows."""
+    if chunk is None:
+        chunk = _auto_chunk(gf)
     n = x.shape[0]
     if n <= chunk:
         return _predict_chunk(gf, x)
@@ -160,9 +175,9 @@ def predict_leaves_gemm(
     return out.reshape(-1, out.shape[-1])[:n]
 
 
-def predict_proba_gemm(gf: GemmForest, x: jnp.ndarray, chunk: int = 8192) -> jnp.ndarray:
+def predict_proba_gemm(gf: GemmForest, x: jnp.ndarray, chunk: int | None = None) -> jnp.ndarray:
     return jnp.mean(predict_leaves_gemm(gf, x, chunk), axis=1)
 
 
-def predict_votes_gemm(gf: GemmForest, x: jnp.ndarray, chunk: int = 8192) -> jnp.ndarray:
+def predict_votes_gemm(gf: GemmForest, x: jnp.ndarray, chunk: int | None = None) -> jnp.ndarray:
     return jnp.sum(predict_leaves_gemm(gf, x, chunk) > 0.5, axis=1).astype(jnp.int32)
