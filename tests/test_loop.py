@@ -44,22 +44,10 @@ def test_run_experiment_produces_monotone_labeled_counts():
     assert all(0.0 <= r.accuracy <= 1.0 for r in res.records)
 
 
-def test_uncertainty_curve_beats_random_on_checkerboard():
-    """The reference's headline claim (results/striatum_*: distUS > distRAND at
-    equal budget). Averaged over seeds on checkerboard4x4 to damp noise."""
-    accs = {"uncertainty": [], "random": []}
-    for seed in (0, 1, 2):
-        for name in accs:
-            cfg = ExperimentConfig(
-                data=DataConfig(name="checkerboard4x4", seed=5),
-                forest=ForestConfig(n_trees=10, max_depth=6),
-                strategy=StrategyConfig(name=name, window_size=30),
-                n_start=10,
-                max_rounds=6,
-                seed=seed,
-            )
-            accs[name].append(run_experiment(cfg).final_accuracy)
-    assert np.mean(accs["uncertainty"]) >= np.mean(accs["random"]) - 0.02, accs
+# The AL-beats-random regression test lives in tests/test_reference_parity.py
+# (test_uncertainty_beats_random_on_reference_fixtures_strictly): it runs on
+# the reference's own committed data files with a strictly positive margin —
+# no slack — replacing the old `mean(us) >= mean(rand) - 0.02` smoke here.
 
 
 def test_label_budget_stops_loop():
