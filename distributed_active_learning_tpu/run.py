@@ -39,6 +39,12 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--strategy", default="uncertainty")
     ap.add_argument("--window", type=int, default=10)
     ap.add_argument("--beta", type=float, default=1.0)
+    ap.add_argument(
+        "--strategy-option", action="append", default=[], metavar="K=V",
+        help="per-strategy option (repeatable), e.g. --strategy-option "
+        "lal_trees=2000 --strategy-option lal_model_path=/tmp/lal.npz; "
+        "values parse as int/float when they look like one",
+    )
     ap.add_argument("--trees", type=int, default=10)
     ap.add_argument("--depth", type=int, default=4)
     ap.add_argument("--n-start", type=int, default=10)
@@ -66,6 +72,11 @@ def build_parser() -> argparse.ArgumentParser:
     )
     ap.add_argument("--train-steps", type=int, default=200)
     ap.add_argument("--mc-samples", type=int, default=8)
+    # BatchBALD bounds (deep.batchbald): the exact joint is tracked while the
+    # config count stays under --batchbald-max-configs, and the greedy batch is
+    # drawn from the top --candidate-pool unlabeled points by marginal BALD.
+    ap.add_argument("--batchbald-max-configs", type=int, default=4096)
+    ap.add_argument("--candidate-pool", type=int, default=512)
     ap.add_argument("--hidden", default="128,64", help="MLP hidden sizes (neural mode)")
     # Transformer encoder size (--model transformer)
     ap.add_argument("--d-model", type=int, default=128)
@@ -73,6 +84,25 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--n-heads", type=int, default=4)
     ap.add_argument("--d-ff", type=int, default=256)
     return ap
+
+
+def _parse_strategy_options(pairs) -> dict:
+    """Parse repeated ``K=V`` flags; numeric-looking values become int/float
+    (the LAL knobs — lal_trees, lal_depth, lal_experiments — are ints; paths
+    stay strings)."""
+    options = {}
+    for pair in pairs:
+        if "=" not in pair:
+            raise SystemExit(f"--strategy-option needs K=V, got {pair!r}")
+        k, v = pair.split("=", 1)
+        for cast in (int, float):
+            try:
+                v = cast(v)
+                break
+            except ValueError:
+                continue
+        options[k] = v
+    return options
 
 
 def main(argv=None) -> int:
@@ -144,7 +174,12 @@ def main(argv=None) -> int:
             seed=args.seed,
         ),
         forest=ForestConfig(n_trees=args.trees, max_depth=args.depth),
-        strategy=StrategyConfig(name=args.strategy, window_size=args.window, beta=args.beta),
+        strategy=StrategyConfig(
+            name=args.strategy,
+            window_size=args.window,
+            beta=args.beta,
+            options=_parse_strategy_options(args.strategy_option),
+        ),
         mesh=MeshConfig(data=args.mesh_data, model=args.mesh_model),
         n_start=args.n_start,
         max_rounds=args.rounds,
@@ -232,6 +267,8 @@ def _run_neural(args, dbg):
         max_rounds=args.rounds,
         label_budget=args.budget,
         seed=args.seed,
+        batchbald_max_configs=args.batchbald_max_configs,
+        batchbald_candidate_pool=args.candidate_pool,
     )
     return run_neural_experiment(
         cfg, learner, bundle.train_x, bundle.train_y, bundle.test_x, bundle.test_y,

@@ -62,6 +62,9 @@ class NeuralExperimentConfig:
     seed: int = 0
     retrain_from_scratch: bool = True  # standard deep-AL protocol
     batchbald_max_configs: int = 4096
+    # Greedy BatchBALD candidates (top-k unlabeled by marginal BALD); larger
+    # pools are truncated to this many — logged when it happens.
+    batchbald_candidate_pool: int = 512
 
 
 def run_neural_experiment(
@@ -128,8 +131,20 @@ def run_neural_experiment(
                 _, picked = select_top_k(scores, unlabeled, cfg.window_size)
             elif strat == "batchbald":
                 probs = learner.predict_proba_samples(net_state, pool_x, k_mc)
+                n_unlabeled = n_pool - n_labeled
+                if n_unlabeled > cfg.batchbald_candidate_pool:
+                    dbg.debug(
+                        f"batchbald: candidate pool truncated to top "
+                        f"{cfg.batchbald_candidate_pool} of {n_unlabeled} "
+                        f"unlabeled points (marginal-BALD ranking); raise "
+                        f"--candidate-pool to widen"
+                    )
                 picked, _ = deep.batchbald_select(
-                    probs, unlabeled, cfg.window_size, cfg.batchbald_max_configs
+                    probs,
+                    unlabeled,
+                    cfg.window_size,
+                    cfg.batchbald_max_configs,
+                    cfg.batchbald_candidate_pool,
                 )
             else:
                 probs = learner.predict_proba_samples(net_state, pool_x, k_mc)
