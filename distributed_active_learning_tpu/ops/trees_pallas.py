@@ -1,0 +1,144 @@
+"""Fused Pallas TPU kernel for path-matrix forest evaluation.
+
+The XLA GEMM kernel (``ops/trees_gemm.py``) lowers to two batched matmuls with
+elementwise stages between them; its ``[chunk, T, I]`` compare and
+``[chunk, T, L]`` hit tensors round-trip through HBM, which caps it at ~5% MFU
+(BENCH_r02/r03: ~10 bf16 TFLOP/s on a v5e whose peak is 197) — the classic
+bandwidth-bound fusion gap. This kernel performs the whole chain
+
+    select features -> compare thresholds -> path GEMM -> leaf-hit test ->
+    leaf-value contraction
+
+for a (row-block x tree-block) tile entirely in VMEM, so HBM traffic drops to
+the inputs (x once per tree-block sweep, path matrices once per row-block) and
+the [BN, I]/[BN, L] intermediates never leave the chip.
+
+Feature selection is itself expressed as an MXU matmul against a one-hot
+``[d, T*I]`` selector (gathers are the one primitive the MXU cannot help
+with), which costs ``2*BN*d_pad*I`` — ~12-50% of the main ``2*BN*I*L`` GEMM
+depending on feature-count padding.
+
+Numerics: features are compared in bfloat16 (they ride the MXU), so a vote can
+differ from the exact f32 kernels only when a feature value sits within bf16
+rounding distance (~0.4%) of a threshold. For device-fit forests
+(``ops/trees_train.py``) thresholds are quantile-bin edges and inputs can be
+integer bin codes — exact in bf16 — so there the kernel is bit-identical.
+The reference's own MLlib trainer bins features to 32 levels
+(``uncertainty_sampling.py:74``), far coarser than bf16 resolution.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from distributed_active_learning_tpu.ops.trees_gemm import GemmForest
+
+# Row-block and tree-block tile sizes. path tile = BT * I * L bf16; at
+# depth 8 (I=L=256) and BT=16 that is 2 MB of VMEM, c/s tiles ~1 MB.
+_BN = 512
+_BT = 16
+
+
+def _kernel(x_ref, sel_ref, thr_ref, path_ref, tgt_ref, val_ref, out_ref):
+    bn = x_ref.shape[0]
+    bt, i_dim = thr_ref.shape
+    # One selection matmul covers every tree in the block: [BN, dp] x
+    # [dp, BT*I] -> feature values routed to each internal-node slot.
+    fv = jnp.dot(x_ref[:], sel_ref[:], preferred_element_type=jnp.float32)
+    c = (fv.reshape(bn, bt, i_dim) <= thr_ref[:][None, :, :]).astype(jnp.bfloat16)
+    preds = []
+    for t in range(bt):
+        # Ancestor-agreement counts: the main MXU GEMM, per tree.
+        s = jnp.dot(c[:, t, :], path_ref[t], preferred_element_type=jnp.float32)
+        hit = (s == tgt_ref[t][None, :]).astype(jnp.float32)  # exactly one 1/row
+        # Leaf payload selection: [BN, L] x [L] matvec (f32: hit is one-hot,
+        # so this is an exact gather-by-matmul of the leaf value).
+        preds.append(jnp.dot(hit, val_ref[t], preferred_element_type=jnp.float32))
+    out_ref[:] = jnp.stack(preds, axis=1)
+
+
+def _pad_to(a: jnp.ndarray, axis: int, mult: int, value=0) -> jnp.ndarray:
+    pad = (-a.shape[axis]) % mult
+    if pad == 0:
+        return a
+    widths = [(0, 0)] * a.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(a, widths, constant_values=value)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def predict_leaves_pallas(
+    gf: GemmForest, x: jnp.ndarray, interpret: bool = False
+) -> jnp.ndarray:
+    """Per-tree leaf values ``[n, T]`` via the fused VMEM-resident kernel."""
+    n, d = x.shape
+    T, I = gf.feat_ids.shape
+    L = gf.value.shape[1]
+
+    # Lane-align the tile dims (last dim 128 for f32/bf16 tiling).
+    i_pad = max(-(-I // 128) * 128, 128)
+    l_pad = max(-(-L // 128) * 128, 128)
+    d_pad = max(-(-d // 128) * 128, 128)
+
+    # One-hot feature selector [d_pad, T*i_pad] (tree-major columns).
+    feat = _pad_to(gf.feat_ids, 1, i_pad)  # padded slots select feature 0...
+    thr = _pad_to(gf.thresholds, 1, i_pad, value=-np.inf)  # ...and compare False
+    sel = jax.nn.one_hot(feat.reshape(-1), d_pad, dtype=jnp.bfloat16)  # [T*ip, dp]
+
+    path = _pad_to(_pad_to(gf.path, 1, i_pad), 2, l_pad).astype(jnp.bfloat16)
+    # Padded leaves carry an unreachable target, padded internal slots a 0 path
+    # row — they add 0 to s and never hit.
+    tgt = _pad_to(gf.target, 1, l_pad, value=1.0e6)
+    val = _pad_to(gf.value, 1, l_pad)
+
+    # Pad rows/trees to tile multiples.
+    xp = _pad_to(x.astype(jnp.bfloat16), 1, d_pad)
+    xp = _pad_to(xp, 0, _BN)
+    n_pad, t_cnt = xp.shape[0], thr.shape[0]
+    bt = min(_BT, t_cnt)
+    sel = _pad_to(sel.reshape(T, i_pad, d_pad), 0, bt)
+    thr = _pad_to(thr, 0, bt, value=-np.inf)
+    path = _pad_to(path, 0, bt)
+    tgt = _pad_to(tgt, 0, bt, value=1.0e6)
+    val = _pad_to(val, 0, bt)
+    t_pad = thr.shape[0]
+    sel = sel.transpose(2, 0, 1).reshape(d_pad, t_pad * i_pad)
+
+    grid = (n_pad // _BN, t_pad // bt)
+    out = pl.pallas_call(
+        _kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((_BN, d_pad), lambda i, j: (i, 0)),
+            pl.BlockSpec((d_pad, bt * i_pad), lambda i, j: (0, j)),
+            pl.BlockSpec((bt, i_pad), lambda i, j: (j, 0)),
+            pl.BlockSpec((bt, i_pad, l_pad), lambda i, j: (j, 0, 0)),
+            pl.BlockSpec((bt, l_pad), lambda i, j: (j, 0)),
+            pl.BlockSpec((bt, l_pad), lambda i, j: (j, 0)),
+        ],
+        out_specs=pl.BlockSpec((_BN, bt), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((n_pad, t_pad), jnp.float32),
+        interpret=interpret,
+    )(xp, sel, thr, path, tgt, val)
+    return out[:n, :T]
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def predict_leaves(gf: GemmForest, x: jnp.ndarray) -> jnp.ndarray:
+    return predict_leaves_pallas(gf, x, interpret=_use_interpret())
+
+
+def predict_proba(gf: GemmForest, x: jnp.ndarray) -> jnp.ndarray:
+    return jnp.mean(predict_leaves(gf, x), axis=1)
+
+
+def predict_votes(gf: GemmForest, x: jnp.ndarray) -> jnp.ndarray:
+    return jnp.sum(predict_leaves(gf, x) > 0.5, axis=1).astype(jnp.int32)
