@@ -102,6 +102,7 @@ class NeuralLearner:
         self.train_steps = train_steps
         self.mc_samples = mc_samples
         self.predict_chunk = predict_chunk
+        self.learning_rate = learning_rate  # kept for checkpoint fingerprints
         self.tx = optax.adam(learning_rate)
 
     def init(self, key: jax.Array) -> TrainState:

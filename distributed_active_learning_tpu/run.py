@@ -125,20 +125,21 @@ def main(argv=None) -> int:
     from distributed_active_learning_tpu.runtime.loop import run_experiment
 
     dbg = Debugger(enabled=not args.quiet)
+    # Both loops gate persistence on dir AND interval; half a request would be
+    # silently ignored, dropping the user's crash-resume protection.
+    if bool(args.checkpoint_dir) != bool(args.checkpoint_every):
+        ap.error(
+            "checkpointing needs both --checkpoint-dir and --checkpoint-every"
+        )
     # The neural (deep-AL) loop runs only when asked for explicitly: via
     # --neural or a namespaced "deep.*" strategy name. Names living in both
     # registries (e.g. "entropy") default to the classic forest path, which is
     # the reference-parity target (density_weighting.py:148).
     if args.neural or args.strategy.startswith("deep."):
-        if args.checkpoint_dir or args.checkpoint_every:
+        if args.mesh_model != 1:
             ap.error(
-                "--checkpoint-dir/--checkpoint-every are not supported on the "
-                "neural path; drop them or use the forest loop"
-            )
-        if args.mesh_data != 1 or args.mesh_model != 1:
-            ap.error(
-                "--mesh-data/--mesh-model are not supported on the neural "
-                "path yet; drop them or use the forest loop"
+                "the neural path shards pool rows only (--mesh-data); "
+                "--mesh-model applies to the forest ensemble axis"
             )
         from distributed_active_learning_tpu.runtime.neural_loop import (
             available_deep_strategies,
@@ -201,6 +202,8 @@ def _run_neural(args, dbg):
     --model cnn`` (SmallCNN over image pools) and ``--dataset agnews --model
     transformer`` (encoder over token-id pools); ``mlp`` serves tabular pools.
     """
+    import dataclasses
+
     import numpy as np
 
     from distributed_active_learning_tpu.data import get_dataset
@@ -210,9 +213,10 @@ def _run_neural(args, dbg):
         run_neural_experiment,
     )
 
-    bundle = get_dataset(
-        DataConfig(name=args.dataset, path=args.data_path, n_samples=args.n_samples, seed=args.seed)
+    data_cfg = DataConfig(
+        name=args.dataset, path=args.data_path, n_samples=args.n_samples, seed=args.seed
     )
+    bundle = get_dataset(data_cfg)
     n_classes = max(int(bundle.train_y.max()) + 1, 2)
 
     kind = args.model
@@ -269,10 +273,15 @@ def _run_neural(args, dbg):
         seed=args.seed,
         batchbald_max_configs=args.batchbald_max_configs,
         batchbald_candidate_pool=args.candidate_pool,
+        checkpoint_dir=args.checkpoint_dir,
+        checkpoint_every=args.checkpoint_every,
+        mesh=MeshConfig(data=args.mesh_data, model=args.mesh_model),
     )
+    # Dataset identity feeds the checkpoint fingerprint, so a resume against a
+    # different dataset/subsample is refused (same guard as the forest loop).
     return run_neural_experiment(
         cfg, learner, bundle.train_x, bundle.train_y, bundle.test_x, bundle.test_y,
-        debugger=dbg,
+        debugger=dbg, data_ident=dataclasses.asdict(data_cfg),
     )
 
 

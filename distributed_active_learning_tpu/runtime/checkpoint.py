@@ -7,10 +7,13 @@ crashed run restarts from scratch (SURVEY.md §5.4). Here a checkpoint captures
 everything needed to resume mid-experiment: the labeled mask, PRNG key, round
 counter, and the accuracy history. Pool features are NOT stored (they are
 reproducible from the dataset config); masks + key make the resumed run
-bit-identical.
+bit-identical. Neural experiments additionally persist the network's
+parameters and optimizer state (:func:`save_neural`).
 
 Format: step-numbered ``.npz`` files (portable, atomic via rename) + the
-records as JSON lines.
+records as JSON lines. Masks are stored over *real* pool rows only — mesh
+padding is a placement detail, so a checkpoint written under one ``--mesh-data``
+resumes under any other (the mesh is deliberately absent from fingerprints).
 """
 
 from __future__ import annotations
@@ -31,15 +34,15 @@ from distributed_active_learning_tpu.runtime.state import PoolState
 _STEP_RE = re.compile(r"^alstate_(\d+)\.npz$")
 
 
-def config_fingerprint(cfg) -> str:
-    """Hash of the experiment's *identity* fields — dataset, forest, strategy,
-    mesh, seeding. Loop controls (max_rounds, label_budget, checkpoint/log
-    paths) are excluded: resuming with a larger round budget is legitimate;
-    resuming under a different strategy or dataset silently continues a
-    mismatched experiment, which :func:`restore_latest` refuses.
-    """
+def fingerprint_from_ident(ident: dict) -> str:
+    """Stable 16-hex-digit hash of an experiment-identity dict."""
     import hashlib
 
+    blob = json.dumps(ident, sort_keys=True, default=str).encode()
+    return hashlib.sha256(blob).hexdigest()[:16]
+
+
+def _forest_ident(cfg, with_mesh: bool) -> dict:
     forest_ident = dataclasses.asdict(cfg.forest)
     # The evaluation kernel is a pure-performance knob (gather/gemm agree
     # bit-for-bit on votes) — switching it between runs is a legitimate resume.
@@ -51,12 +54,58 @@ def config_fingerprint(cfg) -> str:
             **dataclasses.asdict(cfg.strategy),
             "options": dict(cfg.strategy.options),
         },
-        "mesh": dataclasses.asdict(cfg.mesh),
         "n_start": cfg.n_start,
         "seed": cfg.seed,
     }
-    blob = json.dumps(ident, sort_keys=True, default=str).encode()
-    return hashlib.sha256(blob).hexdigest()[:16]
+    if with_mesh:
+        ident["mesh"] = dataclasses.asdict(cfg.mesh)
+    return ident
+
+
+def config_fingerprint(cfg) -> str:
+    """Hash of the experiment's *identity* fields — dataset, forest, strategy,
+    seeding. Loop controls (max_rounds, label_budget, checkpoint/log paths) and
+    the mesh (performance-only: the sharded round matches the unsharded one
+    bit-for-bit, tests/test_parallel.py) are excluded: resuming with a larger
+    round budget or a different device mesh is legitimate; resuming under a
+    different strategy or dataset silently continues a mismatched experiment,
+    which :func:`restore_latest` refuses.
+    """
+    return fingerprint_from_ident(_forest_ident(cfg, with_mesh=False))
+
+
+def accepted_fingerprints(cfg) -> tuple:
+    """Current fingerprint plus the legacy (mesh-included) form, so
+    checkpoints written before the mesh was dropped from the identity still
+    resume when the full config (mesh included) matches."""
+    return (
+        config_fingerprint(cfg),
+        fingerprint_from_ident(_forest_ident(cfg, with_mesh=True)),
+    )
+
+
+def _base_payload(
+    state: PoolState, result: ExperimentResult, fingerprint: Optional[str]
+) -> dict:
+    """The checkpoint fields shared by the forest and neural formats.
+
+    The mask is sliced to real rows so mesh padding never leaks into the file
+    (a checkpoint written at ``--mesh-data 8`` must resume at ``--mesh-data 1``).
+    """
+    payload = {
+        "labeled_mask": np.asarray(state.labeled_mask)[: state.n_valid],
+        "key": np.asarray(jax.random.key_data(state.key)),
+        "round": np.asarray(int(state.round), dtype=np.int32),
+        "records_json": np.frombuffer(
+            json.dumps([dataclasses.asdict(r) for r in result.records]).encode(),
+            dtype=np.uint8,
+        ),
+    }
+    if fingerprint is not None:
+        payload["config_fingerprint"] = np.frombuffer(
+            fingerprint.encode(), dtype=np.uint8
+        )
+    return payload
 
 
 def save(
@@ -67,23 +116,12 @@ def save(
 ) -> str:
     """Write a checkpoint for the state's current round; returns the path."""
     os.makedirs(ckpt_dir, exist_ok=True)
-    step = int(state.round)
-    payload = {
-        "labeled_mask": np.asarray(state.labeled_mask),
-        "key": np.asarray(jax.random.key_data(state.key)),
-        "round": np.asarray(step, dtype=np.int32),
-        "records_json": np.frombuffer(
-            json.dumps([dataclasses.asdict(r) for r in result.records]).encode(),
-            dtype=np.uint8,
-        ),
-    }
-    if fingerprint is not None:
-        payload["config_fingerprint"] = np.frombuffer(
-            fingerprint.encode(), dtype=np.uint8
-        )
     from distributed_active_learning_tpu.utils.io import atomic_savez
 
-    return atomic_savez(os.path.join(ckpt_dir, f"alstate_{step}.npz"), **payload)
+    return atomic_savez(
+        os.path.join(ckpt_dir, f"alstate_{int(state.round)}.npz"),
+        **_base_payload(state, result, fingerprint),
+    )
 
 
 def latest_step(ckpt_dir: str) -> Optional[int]:
@@ -95,6 +133,58 @@ def latest_step(ckpt_dir: str) -> Optional[int]:
         if (m := _STEP_RE.match(fn))
     ]
     return max(steps) if steps else None
+
+
+def _restore_base(
+    z, step: int, state: PoolState, result: ExperimentResult, fingerprint: Optional[str]
+) -> Tuple[PoolState, ExperimentResult]:
+    """Rebuild (state, result) from an open npz payload, enforcing the
+    fingerprint and pool-size guards and re-applying mesh padding."""
+    mask = jnp.asarray(z["labeled_mask"])
+    key = jax.random.wrap_key_data(jnp.asarray(z["key"]))
+    rnd = jnp.asarray(z["round"])
+    records = json.loads(bytes(z["records_json"]).decode())
+    stored_fp = (
+        bytes(z["config_fingerprint"]).decode()
+        if "config_fingerprint" in z.files
+        else None
+    )
+    # ``fingerprint`` may be one hash or a tuple of acceptable hashes (the
+    # current form plus legacy spellings, see accepted_fingerprints).
+    accepted = (fingerprint,) if isinstance(fingerprint, str) else fingerprint
+    if fingerprint is not None and stored_fp is not None and stored_fp not in accepted:
+        raise ValueError(
+            f"checkpoint config fingerprint {stored_fp} != current experiment "
+            f"{accepted[0]}: refusing to resume a different experiment's state"
+        )
+    if fingerprint is not None and stored_fp is None:
+        # Pre-fingerprint checkpoints carry no identity record, so the
+        # config-mismatch guard cannot apply — say so instead of silently
+        # resuming whatever experiment wrote the file.
+        import warnings
+
+        warnings.warn(
+            f"resuming unfingerprinted checkpoint alstate_{step}.npz: the "
+            "config-mismatch guard did not apply",
+            stacklevel=3,
+        )
+    n_stored = mask.shape[0]
+    if n_stored == state.n_valid:
+        pad = state.n_pool - n_stored
+        if pad:
+            # Padding rows read as labeled so selection never picks them
+            # (same convention as state.pad_for_sharding).
+            mask = jnp.pad(mask, (0, pad), constant_values=True)
+    elif n_stored == state.n_pool:
+        pass  # legacy format: mask stored over padded rows
+    else:
+        raise ValueError(
+            f"checkpoint pool size ({n_stored},) != experiment pool "
+            f"({state.n_valid},)"
+        )
+    new_state = state.replace(labeled_mask=mask, key=key, round=rnd)
+    new_result = ExperimentResult(records=[RoundRecord(**r) for r in records])
+    return new_state, new_result
 
 
 def restore_latest(
@@ -113,35 +203,97 @@ def restore_latest(
     if step is None:
         return None
     with np.load(os.path.join(ckpt_dir, f"alstate_{step}.npz")) as z:
-        mask = jnp.asarray(z["labeled_mask"])
-        key = jax.random.wrap_key_data(jnp.asarray(z["key"]))
-        rnd = jnp.asarray(z["round"])
-        records = json.loads(bytes(z["records_json"]).decode())
-        stored_fp = (
-            bytes(z["config_fingerprint"]).decode()
-            if "config_fingerprint" in z.files
-            else None
-        )
-    if fingerprint is not None and stored_fp is not None and stored_fp != fingerprint:
-        raise ValueError(
-            f"checkpoint config fingerprint {stored_fp} != current experiment "
-            f"{fingerprint}: refusing to resume a different experiment's state"
-        )
-    if fingerprint is not None and stored_fp is None:
-        # Pre-fingerprint checkpoints carry no identity record, so the
-        # config-mismatch guard cannot apply — say so instead of silently
-        # resuming whatever experiment wrote the file.
-        import warnings
+        return _restore_base(z, step, state, result, fingerprint)
 
-        warnings.warn(
-            f"resuming unfingerprinted checkpoint alstate_{step}.npz: the "
-            "config-mismatch guard did not apply",
-            stacklevel=2,
-        )
-    if mask.shape != state.labeled_mask.shape:
+
+def save_neural(
+    ckpt_dir: str,
+    state: PoolState,
+    result: ExperimentResult,
+    net_state,
+    loop_key: jax.Array,
+    fingerprint: Optional[str] = None,
+) -> str:
+    """Neural-experiment checkpoint: AL state + network params/optimizer.
+
+    Extends :func:`save` with what the neural loop additionally needs to
+    resume bit-identically: the round-trained network's ``TrainState``
+    (params + optimizer state pytrees, flattened to numbered npz entries) and
+    the loop's own PRNG key. This closes the round-2 gap where the neural path
+    had no persistence at all — a crashed CIFAR run lost every acquired label
+    (the reference persists only *models*, never AL state; SURVEY.md §5.4).
+    """
+    os.makedirs(ckpt_dir, exist_ok=True)
+    payload = _base_payload(state, result, fingerprint)
+    payload["loop_key"] = np.asarray(jax.random.key_data(loop_key))
+    payload["net_step"] = np.asarray(net_state.step, dtype=np.int32)
+    for i, leaf in enumerate(jax.tree_util.tree_leaves(net_state.params)):
+        payload[f"net_param_{i}"] = np.asarray(leaf)
+    for i, leaf in enumerate(jax.tree_util.tree_leaves(net_state.opt_state)):
+        payload[f"net_opt_{i}"] = np.asarray(leaf)
+    from distributed_active_learning_tpu.utils.io import atomic_savez
+
+    return atomic_savez(
+        os.path.join(ckpt_dir, f"alstate_{int(state.round)}.npz"), **payload
+    )
+
+
+def _unflatten_like(template, z, prefix: str, step: int):
+    """Rebuild a pytree from numbered npz entries using ``template``'s
+    structure; leaf count/shape mismatches mean the checkpoint belongs to a
+    differently-shaped network and resuming it would be garbage."""
+    leaves, treedef = jax.tree_util.tree_flatten(template)
+    stored = sorted(
+        (int(k[len(prefix):]) for k in z.files if k.startswith(prefix))
+    )
+    if stored != list(range(len(leaves))):
         raise ValueError(
-            f"checkpoint pool size {mask.shape} != experiment pool {state.labeled_mask.shape}"
+            f"checkpoint alstate_{step}.npz holds {len(stored)} '{prefix}*' "
+            f"arrays but the network has {len(leaves)} — not a checkpoint of "
+            "this model (or not a neural checkpoint at all)"
         )
-    new_state = state.replace(labeled_mask=mask, key=key, round=rnd)
-    new_result = ExperimentResult(records=[RoundRecord(**r) for r in records])
-    return new_state, new_result
+    new_leaves = []
+    for i, tmpl in enumerate(leaves):
+        arr = z[f"{prefix}{i}"]
+        if tuple(arr.shape) != tuple(jnp.shape(tmpl)):
+            raise ValueError(
+                f"checkpoint leaf {prefix}{i} shape {arr.shape} != network "
+                f"leaf shape {jnp.shape(tmpl)}: different architecture"
+            )
+        new_leaves.append(jnp.asarray(arr, dtype=jnp.asarray(tmpl).dtype))
+    return jax.tree_util.tree_unflatten(treedef, new_leaves)
+
+
+def restore_latest_neural(
+    ckpt_dir: str,
+    state: PoolState,
+    result: ExperimentResult,
+    template_net_state,
+    fingerprint: Optional[str] = None,
+):
+    """Load the newest neural checkpoint; ``None`` if the directory is empty.
+
+    Returns ``(state, result, net_state, loop_key)``. The network pytrees are
+    rebuilt against ``template_net_state`` (a freshly initialized TrainState),
+    so architecture drift is caught by shape/leaf-count checks on top of the
+    config-fingerprint guard. One file read covers both the base AL state and
+    the network arrays.
+    """
+    step = latest_step(ckpt_dir)
+    if step is None:
+        return None
+    with np.load(os.path.join(ckpt_dir, f"alstate_{step}.npz")) as z:
+        new_state, new_result = _restore_base(z, step, state, result, fingerprint)
+        if "loop_key" not in z.files:
+            raise ValueError(
+                f"alstate_{step}.npz is not a neural checkpoint (no loop_key/"
+                "network arrays) — it was written by the forest loop"
+            )
+        loop_key = jax.random.wrap_key_data(jnp.asarray(z["loop_key"]))
+        params = _unflatten_like(template_net_state.params, z, "net_param_", step)
+        opt_state = _unflatten_like(template_net_state.opt_state, z, "net_opt_", step)
+        net_step = jnp.asarray(z["net_step"])
+    net_state = type(template_net_state)(
+        params=params, opt_state=opt_state, step=net_step
+    )
+    return new_state, new_result, net_state, loop_key

@@ -213,7 +213,8 @@ def run_experiment(
 
         ckpt_fp = ckpt_lib.config_fingerprint(cfg)
         restored = ckpt_lib.restore_latest(
-            cfg.checkpoint_dir, state, result, fingerprint=ckpt_fp
+            cfg.checkpoint_dir, state, result,
+            fingerprint=ckpt_lib.accepted_fingerprints(cfg),
         )
         if restored is not None:
             state, result = restored

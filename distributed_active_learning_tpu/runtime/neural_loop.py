@@ -15,6 +15,7 @@ from typing import Callable, Dict, Optional
 import jax
 import jax.numpy as jnp
 
+from distributed_active_learning_tpu.config import MeshConfig
 from distributed_active_learning_tpu.models.neural import NeuralLearner, TrainState
 from distributed_active_learning_tpu.ops.topk import select_top_k
 from distributed_active_learning_tpu.runtime import state as state_lib
@@ -65,6 +66,78 @@ class NeuralExperimentConfig:
     # Greedy BatchBALD candidates (top-k unlabeled by marginal BALD); larger
     # pools are truncated to this many — logged when it happens.
     batchbald_candidate_pool: int = 512
+    # Same persistence + distribution knobs as the forest ExperimentConfig
+    # (round-2 gap: the neural path was a parallel universe with neither).
+    checkpoint_dir: Optional[str] = None
+    checkpoint_every: int = 0
+    # Pool rows ride the data axis (DP over the mesh); the network itself is
+    # replicated — its parameters are tiny next to a CIFAR-50k pool, so data
+    # parallelism is the whole win and model sharding stays out of scope.
+    mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+
+
+def neural_fingerprint(
+    cfg: NeuralExperimentConfig, learner: NeuralLearner, data_ident: Optional[dict] = None
+) -> str:
+    """Identity hash for neural checkpoints (counterpart of
+    ``checkpoint.config_fingerprint``): everything that changes the *curve* —
+    strategy, seeding, training protocol, network architecture, dataset —
+    participates; loop controls and the mesh (performance-only) do not.
+    """
+    from distributed_active_learning_tpu.runtime.checkpoint import fingerprint_from_ident
+
+    ident = {
+        "strategy": _normalize_deep_name(cfg.strategy),
+        "window_size": cfg.window_size,
+        "n_start": cfg.n_start,
+        "seed": cfg.seed,
+        "retrain_from_scratch": cfg.retrain_from_scratch,
+        "batchbald": (cfg.batchbald_max_configs, cfg.batchbald_candidate_pool),
+        # flax modules are dataclasses: repr() pins the architecture + sizes.
+        "module": repr(learner.module),
+        "input_shape": learner.input_shape,
+        "train": (
+            learner.train_steps,
+            learner.batch_size,
+            learner.mc_samples,
+            learner.learning_rate,
+        ),
+        "data": data_ident or {},
+    }
+    return fingerprint_from_ident(ident)
+
+
+def _place_on_mesh(cfg: MeshConfig, state, pool_x, net_state):
+    """DP placement: pad the pool to data-axis divisibility, shard its rows
+    (and the state's per-row arrays) over ``data``, replicate the network.
+
+    GSPMD then partitions the already-jitted ``fit_on_mask`` /
+    ``predict_proba_samples`` programs — same math, rows spread over ICI
+    (threefry is partitionable, so dropout draws match the single-device run
+    bit-for-bit). The reference's analogue is RDD-partitioning the pool while
+    the model rides the driver (SURVEY.md §2.4).
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distributed_active_learning_tpu.parallel import make_mesh, shard_pool_state
+
+    if cfg.model > 1:
+        raise ValueError(
+            "the neural path shards pool rows only (--mesh-data); model "
+            f"parallelism of the network (mesh model={cfg.model}) is out of scope"
+        )
+    mesh = make_mesh(data=cfg.data, model=1)
+    state = state_lib.pad_for_sharding(state, cfg.data)
+    state = shard_pool_state(state, mesh)
+    pad = state.n_pool - pool_x.shape[0]
+    if pad:
+        pool_x = jnp.pad(pool_x, ((0, pad),) + ((0, 0),) * (pool_x.ndim - 1))
+    pool_x = jax.device_put(
+        pool_x,
+        NamedSharding(mesh, P("data", *([None] * (pool_x.ndim - 1)))),
+    )
+    net_state = jax.device_put(net_state, NamedSharding(mesh, P()))
+    return mesh, state, pool_x, net_state
 
 
 def run_neural_experiment(
@@ -75,6 +148,7 @@ def run_neural_experiment(
     test_x,
     test_y,
     debugger: Optional[Debugger] = None,
+    data_ident: Optional[dict] = None,
 ) -> ExperimentResult:
     dbg = debugger or Debugger(enabled=False)
     strat = _normalize_deep_name(cfg.strategy)
@@ -100,18 +174,47 @@ def run_neural_experiment(
 
     key = jax.random.key(cfg.seed + 1)
     net_state: TrainState = learner.init(jax.random.key(cfg.seed + 2))
+
+    sharded = cfg.mesh.data * cfg.mesh.model > 1
+    if sharded:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh, state, pool_x, net_state = _place_on_mesh(
+            cfg.mesh, state, pool_x, net_state
+        )
+        # Test arrays ride the mesh replicated so eval shares the round's
+        # device set (mixed committed placements would fail under jit).
+        test_x = jax.device_put(test_x, NamedSharding(mesh, P()))
+        test_y = jax.device_put(test_y, NamedSharding(mesh, P()))
     init_net_state = net_state
 
     result = ExperimentResult()
-    n_pool = state.n_pool
-    round_idx = 0
+    start_round = 0
+    if cfg.checkpoint_dir and cfg.checkpoint_every:
+        from distributed_active_learning_tpu.runtime import checkpoint as ckpt_lib
+
+        ckpt_fp = neural_fingerprint(cfg, learner, data_ident)
+        restored = ckpt_lib.restore_latest_neural(
+            cfg.checkpoint_dir, state, result, net_state, fingerprint=ckpt_fp
+        )
+        if restored is not None:
+            state, result, net_state, key = restored
+            if sharded:
+                _, state, _, net_state = _place_on_mesh(
+                    cfg.mesh, state, pool_x, net_state
+                )
+            start_round = int(state.round)
+            dbg.debug(f"resumed at round {start_round}")
+
+    n_pool = state.n_valid  # real rows; mesh padding is never selectable
+    round_idx = start_round
     while True:
         n_labeled = int(state_lib.labeled_count(state))
         if n_labeled >= n_pool:
             break
         if cfg.label_budget is not None and n_labeled >= cfg.label_budget:
             break
-        if cfg.max_rounds is not None and round_idx >= cfg.max_rounds:
+        if cfg.max_rounds is not None and round_idx - start_round >= cfg.max_rounds:
             break
         round_idx += 1
         key, k_fit, k_mc, k_rand = jax.random.split(key, 4)
@@ -119,15 +222,21 @@ def run_neural_experiment(
         with dbg.phase("train"):
             if cfg.retrain_from_scratch:
                 net_state = init_net_state
+            # Padding rows are labeled_mask=True sentinels — the fit must
+            # sample real labeled rows only (same guard as the forest loop's
+            # device fit).
+            fit_mask = state.labeled_mask
+            if state.n_valid != state.n_pool:
+                fit_mask = fit_mask & state.valid_mask
             net_state = learner.fit_on_mask(
-                net_state, pool_x, state.oracle_y, state.labeled_mask, k_fit
+                net_state, pool_x, state.oracle_y, fit_mask, k_fit
             )
         train_time = dbg.records[-1][1]
 
         with dbg.phase("acquire"):
-            unlabeled = ~state.labeled_mask
+            unlabeled = ~state.labeled_mask  # padding rows read as labeled
             if strat == "random":
-                scores = jax.random.uniform(k_rand, (n_pool,))
+                scores = jax.random.uniform(k_rand, (state.n_pool,))
                 _, picked = select_top_k(scores, unlabeled, cfg.window_size)
             elif strat == "batchbald":
                 probs = learner.predict_proba_samples(net_state, pool_x, k_mc)
@@ -167,4 +276,14 @@ def run_neural_experiment(
                 total_time=train_time + score_time,
             )
         )
+        if (
+            cfg.checkpoint_dir
+            and cfg.checkpoint_every
+            and round_idx % cfg.checkpoint_every == 0
+        ):
+            from distributed_active_learning_tpu.runtime import checkpoint as ckpt_lib
+
+            ckpt_lib.save_neural(
+                cfg.checkpoint_dir, state, result, net_state, key, fingerprint=ckpt_fp
+            )
     return result

@@ -82,14 +82,45 @@ def test_cli_bare_neural_needs_deep_strategy():
         main(["--neural", "--rounds", "1", "--quiet"])
 
 
-def test_cli_neural_checkpoint_flags_rejected():
-    """Checkpoint flags are not supported on the neural path; silently ignoring
-    them would drop a user's crash-resume request."""
+def test_cli_neural_checkpoint_and_mesh(capsys, tmp_path):
+    """The round-2 walls are gone: --checkpoint-dir/--checkpoint-every and
+    --mesh-data now work in neural mode. Two invocations against the same
+    checkpoint dir: the second resumes and extends the curve."""
+    ckpt = str(tmp_path / "ckpt")
+    argv = [
+        "--dataset", "checkerboard2x2", "--strategy", "deep.bald", "--window", "10",
+        "--rounds", "2", "--quiet", "--json", "--train-steps", "20",
+        "--mc-samples", "3", "--hidden", "16",
+        "--checkpoint-dir", ckpt, "--checkpoint-every", "1", "--mesh-data", "2",
+    ]
+    assert main(argv) == 0
+    first = [json.loads(l) for l in capsys.readouterr().out.strip().splitlines()]
+    assert [r["round"] for r in first] == [1, 2]
+    assert main(argv) == 0
+    second = [json.loads(l) for l in capsys.readouterr().out.strip().splitlines()]
+    assert [r["round"] for r in second] == [1, 2, 3, 4]  # resumed, not restarted
+
+
+def test_cli_half_checkpoint_request_rejected():
+    """--checkpoint-dir without --checkpoint-every (or vice versa) would be
+    silently ignored by both loops — refuse it instead."""
     with pytest.raises(SystemExit):
         main([
-            "--dataset", "checkerboard2x2", "--strategy", "deep.bald",
-            "--rounds", "1", "--quiet", "--checkpoint-dir", "/tmp/nope",
-            "--checkpoint-every", "1",
+            "--strategy", "random", "--rounds", "1", "--quiet",
+            "--checkpoint-dir", "/tmp/nope",
+        ])
+    with pytest.raises(SystemExit):
+        main([
+            "--strategy", "deep.bald", "--rounds", "1", "--quiet",
+            "--checkpoint-every", "2",
+        ])
+
+
+def test_cli_neural_mesh_model_rejected():
+    with pytest.raises(SystemExit):
+        main([
+            "--strategy", "deep.bald", "--rounds", "1", "--quiet",
+            "--mesh-model", "2",
         ])
 
 

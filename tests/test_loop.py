@@ -139,6 +139,23 @@ def test_checkpoint_kernel_switch_resumes(tmp_path):
     assert res.records[-1].round == 2  # continued, not refused
 
 
+def test_checkpoint_mesh_switch_resumes(tmp_path):
+    """The mesh is performance-only (sharded round == unsharded round), so a
+    checkpoint written on a 2x1 mesh resumes single-device: masks are stored
+    over real rows and the fingerprint excludes the mesh."""
+    from distributed_active_learning_tpu.config import MeshConfig
+
+    ckpt = os.path.join(tmp_path, "ckpt")
+    # data=3 over the 1000-row pool forces padding (1000 -> 1002), so the
+    # stored-mask-over-real-rows path is exercised, not just the fingerprint.
+    run_experiment(
+        _cfg(max_rounds=1, checkpoint_dir=ckpt, checkpoint_every=1,
+             mesh=MeshConfig(data=3))
+    )
+    res = run_experiment(_cfg(max_rounds=1, checkpoint_dir=ckpt, checkpoint_every=1))
+    assert res.records[-1].round == 2  # continued across the mesh switch
+
+
 def test_checkpoint_unfingerprinted_resume_warns(tmp_path):
     """Pre-fingerprint checkpoints can't be identity-checked; resuming one
     must say so instead of silently skipping the guard."""

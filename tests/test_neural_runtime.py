@@ -1,0 +1,175 @@
+"""Neural-path runtime unification: checkpoint/resume + mesh-data sharding.
+
+Round-2 gap (VERDICT): the neural loop was a parallel universe — no
+persistence (a crashed CIFAR run lost every acquired label) and no sharding
+(one chip was the ceiling for exactly the pools where DP pays). These tests
+pin the unified behavior: bit-identical crash-resume through the same
+``atomic_savez`` + fingerprint machinery as the forest loop, and GSPMD
+data-parallel MC prediction over the 8-device mesh matching single-device.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_active_learning_tpu.config import MeshConfig
+from distributed_active_learning_tpu.models.neural import MLP, NeuralLearner
+from distributed_active_learning_tpu.runtime.neural_loop import (
+    NeuralExperimentConfig,
+    run_neural_experiment,
+)
+
+
+def _pool(n=240, d=6, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    y = (x[:, 0] + 0.5 * x[:, 1] > 0).astype(np.int32)
+    tx = rng.normal(size=(64, d)).astype(np.float32)
+    ty = (tx[:, 0] + 0.5 * tx[:, 1] > 0).astype(np.int32)
+    return x, y, tx, ty
+
+
+def _learner(d=6):
+    return NeuralLearner(
+        MLP(n_classes=2, hidden=(16,)), (d,), train_steps=25, mc_samples=3
+    )
+
+
+def _cfg(**kw):
+    return NeuralExperimentConfig(
+        strategy=kw.pop("strategy", "deep.bald"),
+        window_size=10,
+        n_start=12,
+        max_rounds=kw.pop("max_rounds", 2),
+        seed=kw.pop("seed", 7),
+        **kw,
+    )
+
+
+def _run(cfg, seed=0, d=6, n=240):
+    x, y, tx, ty = _pool(n=n, d=d, seed=seed)
+    return run_neural_experiment(cfg, _learner(d), x, y, tx, ty)
+
+
+def test_neural_checkpoint_resume_bit_identical(tmp_path):
+    """Full 4-round run vs 2-round + resumed 2-round through a checkpoint dir:
+    identical labeled counts and accuracies (masks, loop key, and network
+    state all round-trip)."""
+    full = _run(_cfg(max_rounds=4))
+
+    ckpt = os.path.join(tmp_path, "ckpt")
+    partial = _run(_cfg(max_rounds=2, checkpoint_dir=ckpt, checkpoint_every=1))
+    assert len(partial.records) == 2
+    resumed = _run(_cfg(max_rounds=2, checkpoint_dir=ckpt, checkpoint_every=1))
+    records = resumed.records
+    assert [r.round for r in records] == [1, 2, 3, 4]
+    assert [r.n_labeled for r in records] == [r.n_labeled for r in full.records]
+    np.testing.assert_allclose(
+        [r.accuracy for r in records], [r.accuracy for r in full.records], atol=1e-6
+    )
+
+
+def test_neural_checkpoint_fingerprint_mismatch_raises(tmp_path):
+    ckpt = os.path.join(tmp_path, "ckpt")
+    _run(_cfg(max_rounds=1, checkpoint_dir=ckpt, checkpoint_every=1))
+    with pytest.raises(ValueError, match="fingerprint"):
+        _run(
+            _cfg(
+                strategy="deep.entropy",
+                max_rounds=1,
+                checkpoint_dir=ckpt,
+                checkpoint_every=1,
+            )
+        )
+
+
+def test_neural_checkpoint_rejects_forest_checkpoint(tmp_path):
+    """Pointing a neural resume at a forest-loop checkpoint must fail loudly,
+    not resume garbage."""
+    from distributed_active_learning_tpu.runtime import checkpoint as ckpt_lib
+    from distributed_active_learning_tpu.runtime import state as state_lib
+    from distributed_active_learning_tpu.runtime.results import ExperimentResult
+
+    ckpt = os.path.join(tmp_path, "ckpt")
+    state = state_lib.init_pool_state(
+        np.zeros((240, 0), np.float32), np.zeros(240, np.int32), jax.random.key(0)
+    )
+    ckpt_lib.save(ckpt, state, ExperimentResult())  # forest-format: no net arrays
+    learner = _learner()
+    with pytest.raises(ValueError, match="not a neural checkpoint"):
+        ckpt_lib.restore_latest_neural(
+            ckpt, state, ExperimentResult(), learner.init(jax.random.key(1))
+        )
+
+
+def test_sharded_mc_predict_matches_single_device(devices):
+    """predict_proba_samples with pool rows sharded over the 8-device data
+    axis == the single-device result (GSPMD partitions the same program;
+    partitionable threefry keeps the dropout draws identical)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distributed_active_learning_tpu.parallel import make_mesh
+
+    x, _, _, _ = _pool(n=256)
+    learner = _learner()
+    net = learner.init(jax.random.key(3))
+    k = jax.random.key(4)
+
+    ref = learner.predict_proba_samples(net, jnp.asarray(x), k)
+
+    mesh = make_mesh(data=8, model=1)
+    x_sh = jax.device_put(jnp.asarray(x), NamedSharding(mesh, P("data", None)))
+    net_sh = jax.device_put(net, NamedSharding(mesh, P()))
+    got = learner.predict_proba_samples(net_sh, x_sh, k)
+
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-6)
+
+
+def test_neural_experiment_sharded_matches_unsharded(devices):
+    """The full neural AL curve on an 8-way data mesh matches the
+    single-device curve (pool size divisible by the axis: no padding, so the
+    program is literally the same, just partitioned)."""
+    single = _run(_cfg(max_rounds=3))
+    sharded = _run(_cfg(max_rounds=3, mesh=MeshConfig(data=8)))
+    assert [r.n_labeled for r in sharded.records] == [
+        r.n_labeled for r in single.records
+    ]
+    np.testing.assert_allclose(
+        [r.accuracy for r in sharded.records],
+        [r.accuracy for r in single.records],
+        atol=1e-5,
+    )
+
+
+def test_neural_experiment_sharded_pads_nondivisible_pool(devices):
+    """A 250-row pool on an 8-way mesh pads to 256; padding rows must never be
+    selected and labeled counts must track real rows only."""
+    res = _run(_cfg(max_rounds=3, mesh=MeshConfig(data=8)), n=250)
+    assert [r.n_labeled for r in res.records] == [12, 22, 32]
+    assert all(r.n_unlabeled == 250 - r.n_labeled for r in res.records)
+    assert all(0.0 <= r.accuracy <= 1.0 for r in res.records)
+
+
+def test_neural_checkpoint_written_sharded_resumes_unsharded(tmp_path, devices):
+    """Masks are stored over real rows only, so a checkpoint written under
+    --mesh-data 8 (padded 250->256 pool) resumes on a single device — the mesh
+    is a placement detail, not experiment identity."""
+    ckpt = os.path.join(tmp_path, "ckpt")
+    _run(
+        _cfg(max_rounds=2, mesh=MeshConfig(data=8), checkpoint_dir=ckpt,
+             checkpoint_every=1),
+        n=250,
+    )
+    resumed = _run(
+        _cfg(max_rounds=2, checkpoint_dir=ckpt, checkpoint_every=1), n=250
+    )
+    assert [r.round for r in resumed.records] == [1, 2, 3, 4]
+    assert [r.n_labeled for r in resumed.records] == [12, 22, 32, 42]
+
+
+def test_neural_mesh_model_axis_rejected():
+    with pytest.raises(ValueError, match="model parallelism"):
+        _run(_cfg(max_rounds=1, mesh=MeshConfig(data=4, model=2)))
