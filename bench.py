@@ -89,6 +89,7 @@ def bench_score(args):
     from distributed_active_learning_tpu.ops.scoring import uncertainty_score
     from distributed_active_learning_tpu.ops.topk import select_bottom_k
     from distributed_active_learning_tpu.ops.trees_gemm import GemmForest
+    from distributed_active_learning_tpu.ops.trees_pallas import PallasForest
 
     rng = np.random.default_rng(0)
     pool, train_x, train_y = _make_pool(args, rng)
@@ -99,7 +100,12 @@ def bench_score(args):
         ),
         args.kernel,
     )
-    kernel_used = "gemm" if isinstance(forest, GemmForest) else "gather"
+    if isinstance(forest, PallasForest):
+        kernel_used = "pallas"
+    elif isinstance(forest, GemmForest):
+        kernel_used = "gemm"
+    else:
+        kernel_used = "gather"
     pool_dev = jax.device_put(jnp.asarray(pool))
     unlabeled = jnp.ones(args.pool, dtype=bool)
     window = args.window
@@ -128,9 +134,10 @@ def bench_score(args):
         "vs_baseline": round(scores_per_sec / (SPARK_TREE_POINTS_PER_SEC / args.trees), 1),
         "kernel": kernel_used,
     }
-    if kernel_used == "gemm":
-        T, I = forest.feat_ids.shape
-        L = forest.value.shape[1]
+    if kernel_used in ("gemm", "pallas"):
+        gf = forest.gf if kernel_used == "pallas" else forest
+        T, I = gf.feat_ids.shape
+        L = gf.value.shape[1]
         flops_per_point = 2 * T * I * L + 2 * T * L
         achieved = scores_per_sec * flops_per_point
         peak, chip = _peak_flops()
@@ -297,8 +304,9 @@ def main():
     ap.add_argument("--lal-trees", type=int, default=2000)  # active_learner.py:357
     ap.add_argument("--lal-pool", type=int, default=1000)   # RESULTS.txt workload
     ap.add_argument(
-        "--kernel", choices=["gemm", "gather"], default="gemm",
-        help="forest evaluation kernel (gemm = MXU path-matrix form)",
+        "--kernel", choices=["gemm", "pallas", "gather"], default="pallas",
+        help="forest evaluation kernel (pallas = fused VMEM-resident kernel, "
+        "the fastest scoring path; gemm = two-batched-GEMM path-matrix form)",
     )
     args = ap.parse_args()
 

@@ -29,10 +29,14 @@ class ForestConfig:
     max_bins: int = 32
     criterion: str = "gini"
     # Device evaluation kernel: "gemm" re-expresses traversal as two batched
-    # MXU matmuls (ops/trees_gemm.py) — the fast path; "gather" keeps the
-    # vmapped pointer-chase (ops/trees.py). Both agree bit-for-bit on votes.
-    # Deep forests (max_depth > 10) automatically use "gather" (the path
-    # matrix grows O(4^depth); see ops.forest_eval.for_kernel).
+    # MXU matmuls (ops/trees_gemm.py) — exact, bit-identical to "gather", the
+    # default; "pallas" fuses the whole chain in one VMEM-resident kernel
+    # (ops/trees_pallas.py, ~2.5x faster scoring on TPU; features compare in
+    # bf16, exact for binned/grid data); "gather" keeps the vmapped
+    # pointer-chase (ops/trees.py). Deep forests (max_depth > 10)
+    # automatically use "gather" (the path matrix grows O(4^depth); see
+    # ops.forest_eval.for_kernel). Multi-device meshes evaluate "pallas" as
+    # "gemm" (no GSPMD partitioning rule for pallas_call).
     kernel: str = "gemm"
     # Where the forest is *trained*: "host" fits sklearn on the labeled subset
     # (the JVM-fit equivalent); "device" runs the jitted histogram trainer

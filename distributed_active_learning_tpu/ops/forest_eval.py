@@ -1,18 +1,23 @@
 """Kernel-agnostic forest evaluation.
 
-Two device representations of the same fitted forest exist:
+Three device representations of the same fitted forest exist:
 
 - :class:`~distributed_active_learning_tpu.ops.trees.PackedForest` — gather
   traversal, ``O(depth)`` memory, bound by per-element gather throughput;
 - :class:`~distributed_active_learning_tpu.ops.trees_gemm.GemmForest` — the
-  path-matrix form whose dominant work is two batched GEMMs the MXU tiles.
+  path-matrix form whose dominant work is two batched GEMMs the MXU tiles;
+- :class:`~distributed_active_learning_tpu.ops.trees_pallas.PallasForest` —
+  the same path-matrix data evaluated by one fused Pallas kernel that keeps
+  the compare/hit intermediates in VMEM (lifting the HBM-bandwidth cap of the
+  two-GEMM form).
 
 Strategies and the round function call through these dispatchers so the kernel
 choice is a config knob (``ForestConfig.kernel``), not a code path: the pytree
 *type* of the forest argument selects the implementation at trace time, and
-both kernels agree bit-for-bit on votes/probabilities (asserted in
-``tests/test_trees_gemm.py``). This is the single launch that replaces the
-reference's per-tree Spark-job loop (``classes/active_learner.py:169-184``).
+all kernels agree bit-for-bit on votes/probabilities on bf16-exact inputs
+(asserted in ``tests/test_trees_gemm.py`` / ``tests/test_trees_pallas.py``).
+This is the single launch that replaces the reference's per-tree Spark-job
+loop (``classes/active_learner.py:169-184``).
 """
 
 from __future__ import annotations
@@ -21,9 +26,9 @@ from typing import Union
 
 import jax.numpy as jnp
 
-from distributed_active_learning_tpu.ops import trees, trees_gemm
+from distributed_active_learning_tpu.ops import trees, trees_gemm, trees_pallas
 
-Forest = Union[trees.PackedForest, trees_gemm.GemmForest]
+Forest = Union[trees.PackedForest, trees_gemm.GemmForest, trees_pallas.PallasForest]
 
 # Deepest forest converted to path-matrix form; beyond this the O(4^depth)
 # path tensor outgrows its MXU advantage (and, eventually, host memory).
@@ -34,8 +39,14 @@ def _is_gemm(forest: Forest) -> bool:
     return isinstance(forest, trees_gemm.GemmForest)
 
 
+def _is_pallas(forest: Forest) -> bool:
+    return isinstance(forest, trees_pallas.PallasForest)
+
+
 def leaves(forest: Forest, x: jnp.ndarray) -> jnp.ndarray:
     """Per-tree leaf values ``[n, T]`` via whichever kernel the forest carries."""
+    if _is_pallas(forest):
+        return trees_pallas.predict_leaves(forest, x)
     if _is_gemm(forest):
         return trees_gemm.predict_leaves_gemm(forest, x)
     return trees.predict_leaves(forest, x)
@@ -43,6 +54,8 @@ def leaves(forest: Forest, x: jnp.ndarray) -> jnp.ndarray:
 
 def proba(forest: Forest, x: jnp.ndarray) -> jnp.ndarray:
     """P(class 1) per point ``[n]`` (mean of per-tree leaf probabilities)."""
+    if _is_pallas(forest):
+        return trees_pallas.predict_proba(forest, x)
     if _is_gemm(forest):
         return trees_gemm.predict_proba_gemm(forest, x)
     return trees.predict_proba(forest, x)
@@ -50,6 +63,8 @@ def proba(forest: Forest, x: jnp.ndarray) -> jnp.ndarray:
 
 def votes(forest: Forest, x: jnp.ndarray) -> jnp.ndarray:
     """Hard positive-vote count per point ``[n]`` (``uncertainty_sampling.py:96``)."""
+    if _is_pallas(forest):
+        return trees_pallas.predict_votes(forest, x)
     if _is_gemm(forest):
         return trees_gemm.predict_votes_gemm(forest, x)
     return trees.predict_votes(forest, x)
@@ -58,6 +73,8 @@ def votes(forest: Forest, x: jnp.ndarray) -> jnp.ndarray:
 def value(forest: Forest, x: jnp.ndarray) -> jnp.ndarray:
     """Regression prediction per point ``[n]`` (the LAL-regressor predict,
     ``active_learner.py:319-321``)."""
+    if _is_pallas(forest):
+        return trees_pallas.predict_proba(forest, x)
     if _is_gemm(forest):
         return trees_gemm.predict_proba_gemm(forest, x)
     return trees.predict_value(forest, x)
@@ -68,9 +85,10 @@ def for_kernel(forest: trees.PackedForest, kernel: str) -> Forest:
 
     ``"gemm"`` (the default in :class:`ForestConfig`) builds the path-matrix
     form once per fit — a host-side restructure that is trivial next to the
-    sklearn fit itself; ``"gather"`` keeps the traversal form.
+    sklearn fit itself; ``"pallas"`` wraps the same form for the fused VMEM
+    kernel; ``"gather"`` keeps the traversal form.
     """
-    if kernel == "gemm":
+    if kernel in ("gemm", "pallas"):
         # The path matrix is O(T · 4^depth); past depth 10 (~4 MB/tree) the
         # form stops paying for itself and would eventually OOM the host, so
         # deep forests keep the gather traversal. Callers can detect which
@@ -80,9 +98,12 @@ def for_kernel(forest: trees.PackedForest, kernel: str) -> Forest:
             return forest
         # Depth-derived I/L budgets keep the path-matrix shapes identical
         # across per-round refits, so the jitted round never recompiles.
-        return trees_gemm.gemm_forest_from_packed(
+        gf = trees_gemm.gemm_forest_from_packed(
             forest, n_internal=2**d - 1, n_leaves=2**d
         )
+        return trees_pallas.PallasForest(gf=gf) if kernel == "pallas" else gf
     if kernel == "gather":
         return forest
-    raise ValueError(f"unknown forest kernel {kernel!r}; use 'gemm' or 'gather'")
+    raise ValueError(
+        f"unknown forest kernel {kernel!r}; use 'gemm', 'pallas', or 'gather'"
+    )

@@ -47,6 +47,12 @@ def build_parser() -> argparse.ArgumentParser:
     )
     ap.add_argument("--trees", type=int, default=10)
     ap.add_argument("--depth", type=int, default=4)
+    ap.add_argument(
+        "--kernel", choices=["gemm", "pallas", "gather"], default="gemm",
+        help="forest evaluation kernel: gemm (exact MXU path-matrix form, "
+        "default), pallas (fused VMEM kernel, ~2.5x faster scoring; bf16 "
+        "feature compares), gather (traversal form)",
+    )
     ap.add_argument("--n-start", type=int, default=10)
     ap.add_argument("--rounds", type=int, default=None)
     ap.add_argument("--budget", type=int, default=None, help="stop at N labeled")
@@ -174,7 +180,7 @@ def main(argv=None) -> int:
             n_samples=args.n_samples,
             seed=args.seed,
         ),
-        forest=ForestConfig(n_trees=args.trees, max_depth=args.depth),
+        forest=ForestConfig(n_trees=args.trees, max_depth=args.depth, kernel=args.kernel),
         strategy=StrategyConfig(
             name=args.strategy,
             window_size=args.window,

@@ -16,6 +16,7 @@ subset and a scalar accuracy.
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 from typing import Optional, Tuple
 
@@ -115,7 +116,10 @@ def make_device_fit(cfg: ExperimentConfig, edges: jnp.ndarray, budget: int):
     from distributed_active_learning_tpu.ops import trees_train
 
     fc = cfg.forest
-    to_gemm = fc.kernel == "gemm" and fc.max_depth <= forest_eval._GEMM_MAX_DEPTH
+    to_gemm = (
+        fc.kernel in ("gemm", "pallas")
+        and fc.max_depth <= forest_eval._GEMM_MAX_DEPTH
+    )
 
     @jax.jit
     def fit(codes: jnp.ndarray, state: state_lib.PoolState, key: jax.Array):
@@ -126,7 +130,14 @@ def make_device_fit(cfg: ExperimentConfig, edges: jnp.ndarray, budget: int):
             n_trees=fc.n_trees, max_depth=fc.max_depth, n_bins=fc.max_bins,
         )
         if to_gemm:
-            return trees_train.heap_gemm_forest(f, th, v, fc.max_depth)
+            gf = trees_train.heap_gemm_forest(f, th, v, fc.max_depth)
+            if fc.kernel == "pallas":
+                # Device-fit trees split on bin codes — exact in bf16, so the
+                # fused kernel is bit-identical here (module docstring).
+                from distributed_active_learning_tpu.ops.trees_pallas import PallasForest
+
+                return PallasForest(gf=gf)
+            return gf
         return trees_train.heap_packed_forest(f, th, v, fc.max_depth)
 
     return fit
@@ -190,6 +201,13 @@ def run_experiment(
             raise ValueError(
                 f"n_trees={cfg.forest.n_trees} not divisible by mesh "
                 f"model axis {cfg.mesh.model}"
+            )
+        if cfg.forest.kernel == "pallas":
+            # pallas_call has no GSPMD partitioning rule; the gemm form is
+            # bit-identical and shards, so multi-device rounds use it.
+            dbg.debug("mesh>1: kernel 'pallas' falls back to 'gemm' (sharded)")
+            cfg = dataclasses.replace(
+                cfg, forest=dataclasses.replace(cfg.forest, kernel="gemm")
             )
         mesh = make_mesh(data=cfg.mesh.data, model=cfg.mesh.model)
         state = state_lib.pad_for_sharding(state, cfg.mesh.data)

@@ -101,6 +101,20 @@ def test_cli_neural_checkpoint_and_mesh(capsys, tmp_path):
     assert [r["round"] for r in second] == [1, 2, 3, 4]  # resumed, not restarted
 
 
+def test_cli_pallas_kernel_with_mesh_falls_back(capsys):
+    """--kernel pallas is a CLI knob; under a >1-device mesh it degrades to
+    the bit-identical gemm form (pallas_call has no GSPMD rule) and the run
+    completes."""
+    rc = main([
+        "--dataset", "checkerboard2x2", "--strategy", "uncertainty",
+        "--window", "20", "--rounds", "2", "--quiet", "--json",
+        "--kernel", "pallas", "--mesh-data", "2",
+    ])
+    assert rc == 0
+    lines = [json.loads(l) for l in capsys.readouterr().out.strip().splitlines()]
+    assert len(lines) == 2
+
+
 def test_cli_half_checkpoint_request_rejected():
     """--checkpoint-dir without --checkpoint-every (or vice versa) would be
     silently ignored by both loops — refuse it instead."""

@@ -1,0 +1,102 @@
+"""Fused Pallas forest kernel: parity vs the gather/GEMM kernels.
+
+Runs in Pallas interpret mode on CPU (the TPU lowering is exercised by
+``bench.py --kernel pallas`` on hardware). Feature values and sklearn
+midpoint thresholds are placed on a half-integer grid so bf16 comparison is
+exact and all three kernels must agree bit-for-bit.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distributed_active_learning_tpu.config import ForestConfig
+from distributed_active_learning_tpu.models.forest import fit_forest_classifier
+from distributed_active_learning_tpu.ops import forest_eval, trees, trees_gemm, trees_pallas
+
+
+def _grid_forest(n=500, d=7, trees_=10, depth=4, seed=0):
+    """Forest fit on half-integer-grid features (exact in bf16)."""
+    rng = np.random.default_rng(seed)
+    x = rng.integers(0, 32, size=(n, d)).astype(np.float32)
+    y = ((x[:, 0] + x[:, 1] > 30)).astype(np.int32)
+    packed = fit_forest_classifier(x, y, ForestConfig(n_trees=trees_, max_depth=depth))
+    pool = rng.integers(0, 32, size=(257, d)).astype(np.float32)  # odd row count
+    return packed, jnp.asarray(pool)
+
+
+def test_pallas_matches_gather_and_gemm():
+    packed, pool = _grid_forest()
+    gf = trees_gemm.gemm_forest_from_packed(packed)
+
+    ref = np.asarray(trees.predict_leaves(packed, pool))
+    gemm = np.asarray(trees_gemm.predict_leaves_gemm(gf, pool))
+    pallas = np.asarray(trees_pallas.predict_leaves_pallas(gf, pool, interpret=True))
+
+    np.testing.assert_allclose(gemm, ref, atol=0)
+    np.testing.assert_allclose(pallas, ref, atol=0)
+
+
+def test_pallas_tree_count_not_tile_multiple():
+    """T=19 pads past the 16-tree block; padded trees must not leak votes."""
+    packed, pool = _grid_forest(trees_=19, depth=3)
+    gf = trees_gemm.gemm_forest_from_packed(packed)
+    ref = np.asarray(trees.predict_votes(packed, pool))
+    got = np.asarray(trees_pallas.predict_votes(gf, pool))
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_pallas_kernel_reachable_from_config():
+    """ForestConfig(kernel='pallas') routes scoring through the fused kernel
+    (PallasForest wrapper type selects the implementation at trace time)."""
+    packed, pool = _grid_forest(trees_=5, depth=3)
+    forest = forest_eval.for_kernel(packed, "pallas")
+    assert isinstance(forest, trees_pallas.PallasForest)
+    ref = np.asarray(forest_eval.proba(forest_eval.for_kernel(packed, "gather"), pool))
+    got = np.asarray(forest_eval.proba(forest, pool))
+    np.testing.assert_allclose(got, ref, atol=0)
+    votes_ref = np.asarray(forest_eval.votes(forest_eval.for_kernel(packed, "gemm"), pool))
+    votes_got = np.asarray(forest_eval.votes(forest, pool))
+    np.testing.assert_array_equal(votes_got, votes_ref)
+
+
+def test_pallas_kernel_runs_experiment_end_to_end():
+    """kernel='pallas' + fit='device' drives a whole AL experiment: the
+    device-fit heap forest is wrapped for the fused kernel inside the jitted
+    fit, and binned splits make the bf16 compare exact (same curve as gemm)."""
+    from distributed_active_learning_tpu.config import (
+        DataConfig,
+        ExperimentConfig,
+        StrategyConfig,
+    )
+    from distributed_active_learning_tpu.runtime.loop import run_experiment
+
+    def _run(kernel):
+        return run_experiment(
+            ExperimentConfig(
+                data=DataConfig(name="checkerboard2x2", n_samples=300, seed=1),
+                forest=ForestConfig(n_trees=8, max_depth=4, kernel=kernel, fit="device"),
+                strategy=StrategyConfig(name="uncertainty", window_size=15),
+                n_start=10,
+                max_rounds=3,
+            )
+        )
+
+    pallas_res = _run("pallas")
+    gemm_res = _run("gemm")
+    assert [r.n_labeled for r in pallas_res.records] == [10, 25, 40]
+    np.testing.assert_allclose(
+        [r.accuracy for r in pallas_res.records],
+        [r.accuracy for r in gemm_res.records],
+        atol=0,
+    )
+
+
+def test_pallas_deep_forest_falls_back_like_gemm():
+    """Past the path-matrix depth cap the pallas spelling degrades to the
+    gather representation, same as kernel='gemm'."""
+    packed, _ = _grid_forest(trees_=3, depth=3)
+    deep = packed.replace(max_depth=forest_eval._GEMM_MAX_DEPTH + 1)
+    assert isinstance(forest_eval.for_kernel(deep, "pallas"), trees.PackedForest)
