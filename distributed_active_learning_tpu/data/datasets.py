@@ -204,13 +204,15 @@ def _cifar10(cfg: DataConfig) -> DataBundle:
         train_x, train_y = np.concatenate(xs), np.concatenate(ys)
         test_x, test_y = load_batch("test_batch")
         return DataBundle(train_x, train_y, test_x, test_y, "cifar10")
-    k_tr, k_te = jax.random.split(jax.random.key(cfg.seed))
     from distributed_active_learning_tpu.data.synthetic import make_synthetic_images
 
-    tx, ty = make_synthetic_images(k_tr, 2000)
-    ex, ey = make_synthetic_images(k_te, 500)
+    # One draw, then split: the class prototypes are sampled from the key, so
+    # separate train/test draws would define two unrelated labelings (test
+    # accuracy pinned at chance no matter the learner).
+    x, y = make_synthetic_images(jax.random.key(cfg.seed), 2500)
     return DataBundle(
-        np.asarray(tx), np.asarray(ty), np.asarray(ex), np.asarray(ey), "cifar10"
+        np.asarray(x[:2000]), np.asarray(y[:2000]),
+        np.asarray(x[2000:]), np.asarray(y[2000:]), "cifar10",
     )
 
 

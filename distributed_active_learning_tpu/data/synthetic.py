@@ -116,7 +116,12 @@ def make_random_matrix(key: jax.Array, n: int, d: int) -> jnp.ndarray:
 
 
 def make_synthetic_images(
-    key: jax.Array, n: int, n_classes: int = 10, hw: int = 32, channels: int = 3
+    key: jax.Array,
+    n: int,
+    n_classes: int = 10,
+    hw: int = 32,
+    channels: int = 3,
+    noise: float = 6.0,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """CIFAR-shaped stand-in pool: ``[n, hw, hw, c] float32`` + labels.
 
@@ -125,14 +130,19 @@ def make_synthetic_images(
     while shapes/dtypes match CIFAR-10 exactly (BASELINE.json config 4). Used
     when no local CIFAR files are supplied — the real batches load via
     data/datasets.py:cifar10 with cfg.path.
+
+    The prototypes are drawn from ``key``: train/test splits must come from
+    ONE call (slice the result), or their labelings are unrelated. The default
+    ``noise`` is tuned (v5e sweep) so a SmallCNN has an AL-meaningful learning
+    curve rather than a ceiling: ~12% test accuracy at 20 labels, ~61% at 100,
+    ~99% at 400 — accuracy-vs-labels has room to rise across a window-100 run.
     """
     k_proto, k_noise, k_lab = jax.random.split(key, 3)
     # low-frequency prototypes: upsampled 4x4 random patterns
     coarse = jax.random.normal(k_proto, (n_classes, 4, 4, channels))
     protos = jax.image.resize(coarse, (n_classes, hw, hw, channels), "bilinear")
     y = jax.random.randint(k_lab, (n,), 0, n_classes)
-    noise = 0.6 * jax.random.normal(k_noise, (n, hw, hw, channels))
-    x = protos[y] + noise
+    x = protos[y] + noise * jax.random.normal(k_noise, (n, hw, hw, channels))
     return x.astype(jnp.float32), y.astype(jnp.int32)
 
 

@@ -86,10 +86,12 @@ def _tiny_images_entry(cfg):
     from distributed_active_learning_tpu.data.datasets import DataBundle
     from distributed_active_learning_tpu.data.synthetic import make_synthetic_images
 
-    k1, k2 = jax.random.split(jax.random.key(cfg.seed))
-    tx, ty = make_synthetic_images(k1, 120, n_classes=3, hw=8)
-    ex, ey = make_synthetic_images(k2, 40, n_classes=3, hw=8)
-    return DataBundle(np.asarray(tx), np.asarray(ty), np.asarray(ex), np.asarray(ey), "tiny_images")
+    # One draw, then split (prototypes are key-derived; see make_synthetic_images).
+    x, y = make_synthetic_images(jax.random.key(cfg.seed), 160, n_classes=3, hw=8)
+    return DataBundle(
+        np.asarray(x[:120]), np.asarray(y[:120]),
+        np.asarray(x[120:]), np.asarray(y[120:]), "tiny_images",
+    )
 
 
 def test_cli_cnn_model_end_to_end(capsys):
