@@ -185,7 +185,10 @@ def bench_round(args):
 
     # Same depth guard as the product path (forest_eval._GEMM_MAX_DEPTH): deep
     # forests keep the gather traversal instead of a 4^depth path tensor.
-    to_gemm = fc.max_depth <= forest_eval._GEMM_MAX_DEPTH
+    to_gemm = (
+        args.kernel in ("gemm", "pallas")
+        and fc.max_depth <= forest_eval._GEMM_MAX_DEPTH
+    )
 
     @jax.jit
     def device_round(codes, y, mask, key):
@@ -196,6 +199,12 @@ def bench_round(args):
         )
         if to_gemm:
             forest = trees_train.heap_gemm_forest(f, th, v, fc.max_depth)
+            if args.kernel == "pallas":
+                from distributed_active_learning_tpu.ops.trees_pallas import (
+                    PallasForest,
+                )
+
+                forest = PallasForest(gf=forest)
         else:
             forest = trees_train.heap_packed_forest(f, th, v, fc.max_depth)
         return score_select(forest, pool_dev, mask)
@@ -212,7 +221,7 @@ def bench_round(args):
     def run_host():
         lx, ly = pool[mask0], pool_y[mask0]
         packed = fit_forest_classifier(lx, ly, fc)
-        forest = forest_eval.for_kernel(packed, "gemm")
+        forest = forest_eval.for_kernel(packed, args.kernel)
         jax.block_until_ready(score_select(forest, pool_dev, mask_dev))
 
     run_host()  # compile
@@ -250,7 +259,7 @@ def bench_lal(args):
     feats, targets = generate_lal_dataset(seed=0, n_experiments=20)
     lal_forest = forest_eval.for_kernel(
         train_lal_regressor(feats, targets, n_trees=args.lal_trees, max_depth=8),
-        "gemm",
+        args.kernel,
     )
 
     rng = np.random.default_rng(0)
@@ -277,7 +286,7 @@ def bench_lal(args):
         packed = fit_forest_classifier(
             pool_x[mask_host], pool_y[mask_host], base_cfg
         )
-        forest = forest_eval.for_kernel(packed, "gemm")
+        forest = forest_eval.for_kernel(packed, args.kernel)
         out = lal_query(forest, lal_forest, state)
         jax.block_until_ready(out)
 
