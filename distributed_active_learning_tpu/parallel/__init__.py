@@ -31,3 +31,8 @@ from distributed_active_learning_tpu.parallel.collectives import (
     vector_accumulate,
     masked_mean,
 )
+from distributed_active_learning_tpu.parallel.multihost import (
+    maybe_initialize,
+    is_primary,
+    process_count,
+)

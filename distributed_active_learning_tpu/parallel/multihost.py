@@ -1,0 +1,74 @@
+"""Multi-host (DCN) initialization for pools larger than one slice.
+
+The reference scales out with Spark executors over TCP (SURVEY.md §5.8); the
+TPU-native equivalent is ``jax.distributed``: every host runs the SAME
+program, ``jax.devices()`` spans all hosts after initialization, and the
+meshes built by :func:`parallel.mesh.make_mesh` simply cover more devices —
+XLA routes collectives over ICI within a slice and DCN across slices. No
+other code changes: the AL round, the shard_map kernels, and GSPMD neural
+training are already written against a mesh of arbitrary size.
+
+Host-side responsibilities under multi-host SPMD:
+
+- every process must execute the same jitted computations in the same order
+  (the driver loop in ``runtime/loop.py`` is already deterministic given the
+  config);
+- host-only steps (sklearn fit, oracle reveal logging) run identically on
+  each process from the same seed, so no cross-host coordination is needed
+  beyond the jax.distributed barrier at init;
+- checkpoints should be written by process 0 only (``is_primary``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+_ENV_COORD = "JAX_COORDINATOR_ADDRESS"
+_ENV_NPROC = "JAX_NUM_PROCESSES"
+_ENV_PID = "JAX_PROCESS_ID"
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Join the multi-host job (wrapper over ``jax.distributed.initialize``).
+
+    Arguments default to the ``JAX_COORDINATOR_ADDRESS`` / ``JAX_NUM_PROCESSES``
+    / ``JAX_PROCESS_ID`` environment variables (the standard launcher
+    contract); on Cloud TPU pods all three are auto-detected and may be left
+    unset entirely.
+    """
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def maybe_initialize() -> bool:
+    """Initialize iff a multi-host launch is configured; returns whether it was.
+
+    Single-host runs (no coordinator env, one process) skip initialization —
+    calling ``jax.distributed.initialize`` there would start a coordination
+    service nothing connects to.
+    """
+    nproc = os.environ.get(_ENV_NPROC)
+    if os.environ.get(_ENV_COORD) is None or nproc is None or int(nproc) <= 1:
+        return False
+    initialize()
+    return True
+
+
+def is_primary() -> bool:
+    """True on the process that should own host-side writes (checkpoints,
+    results logs)."""
+    return jax.process_index() == 0
+
+
+def process_count() -> int:
+    return jax.process_count()

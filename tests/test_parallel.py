@@ -175,3 +175,19 @@ def test_mesh_model_axis_must_divide_trees():
     )
     with pytest.raises(ValueError, match="not divisible"):
         run_experiment(cfg)
+
+
+def test_multihost_helpers_single_host(monkeypatch):
+    """Without a launcher-provided coordinator the multi-host init is a no-op
+    (starting a coordination service nothing joins would hang real runs);
+    the single process is primary."""
+    from distributed_active_learning_tpu.parallel import multihost
+
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    monkeypatch.delenv("JAX_NUM_PROCESSES", raising=False)
+    assert multihost.maybe_initialize() is False
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "localhost:1234")
+    monkeypatch.setenv("JAX_NUM_PROCESSES", "1")
+    assert multihost.maybe_initialize() is False  # one process: nothing to join
+    assert multihost.is_primary()
+    assert multihost.process_count() == 1
