@@ -90,6 +90,12 @@ def _standardize(bundle: DataBundle, cfg: DataConfig, independent_test: bool = F
 
 
 def _synth(cfg: DataConfig, gen, n_train: int, n_test: int, name: str, **kw) -> DataBundle:
+    if cfg.n_samples is not None:
+        # Synthetic pools are generated, not read: honor the requested size in
+        # BOTH directions (10k-pool scale runs were silently capped at the
+        # 1000-row default before; labels here are key-independent functions
+        # of x, so larger draws stay consistent with the test split).
+        n_train = cfg.n_samples
     k_tr, k_te = jax.random.split(jax.random.key(cfg.seed))
     train_x, train_y = gen(k_tr, n_train, **kw)
     test_x, test_y = gen(k_te, n_test, **kw)

@@ -113,8 +113,14 @@ def save(
     state: PoolState,
     result: ExperimentResult,
     fingerprint: Optional[str] = None,
-) -> str:
-    """Write a checkpoint for the state's current round; returns the path."""
+) -> Optional[str]:
+    """Write a checkpoint for the state's current round; returns the path.
+
+    Under multi-host SPMD every process runs the loop; only process 0 writes
+    (``parallel.multihost.is_primary``) — returns ``None`` elsewhere.
+    """
+    if jax.process_index() != 0:
+        return None
     os.makedirs(ckpt_dir, exist_ok=True)
     from distributed_active_learning_tpu.utils.io import atomic_savez
 
@@ -222,7 +228,10 @@ def save_neural(
     the loop's own PRNG key. This closes the round-2 gap where the neural path
     had no persistence at all — a crashed CIFAR run lost every acquired label
     (the reference persists only *models*, never AL state; SURVEY.md §5.4).
+    Primary-process-only under multi-host, like :func:`save`.
     """
+    if jax.process_index() != 0:
+        return None
     os.makedirs(ckpt_dir, exist_ok=True)
     payload = _base_payload(state, result, fingerprint)
     payload["loop_key"] = np.asarray(jax.random.key_data(loop_key))

@@ -132,8 +132,9 @@ def make_device_fit(cfg: ExperimentConfig, edges: jnp.ndarray, budget: int):
         if to_gemm:
             gf = trees_train.heap_gemm_forest(f, th, v, fc.max_depth)
             if fc.kernel == "pallas":
-                # Device-fit trees split on bin codes — exact in bf16, so the
-                # fused kernel is bit-identical here (module docstring).
+                # Fused-kernel scoring compares float features in bf16; a
+                # point within bf16 rounding of a threshold can flip a vote
+                # (trees_pallas module docstring — numerics).
                 from distributed_active_learning_tpu.ops.trees_pallas import PallasForest
 
                 return PallasForest(gf=gf)

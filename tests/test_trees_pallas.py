@@ -63,9 +63,14 @@ def test_pallas_kernel_reachable_from_config():
 
 
 def test_pallas_kernel_runs_experiment_end_to_end():
-    """kernel='pallas' + fit='device' drives a whole AL experiment: the
-    device-fit heap forest is wrapped for the fused kernel inside the jitted
-    fit, and binned splits make the bf16 compare exact (same curve as gemm)."""
+    """kernel='pallas' + fit='device' drives a whole AL experiment.
+
+    The curves track gemm closely but not bit-for-bit: scoring compares
+    *float* features (standardized pool) against quantile-edge thresholds in
+    bf16, so a point within bf16 rounding of an edge can flip one vote —
+    tolerance is a couple of test-point flips (0.005 on a 1000-row test set).
+    Exact bit-parity on bf16-exact inputs is pinned by the grid tests above.
+    """
     from distributed_active_learning_tpu.config import (
         DataConfig,
         ExperimentConfig,
@@ -90,7 +95,7 @@ def test_pallas_kernel_runs_experiment_end_to_end():
     np.testing.assert_allclose(
         [r.accuracy for r in pallas_res.records],
         [r.accuracy for r in gemm_res.records],
-        atol=0,
+        atol=0.005,
     )
 
 
