@@ -25,7 +25,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from distributed_active_learning_tpu.ops.similarity import l2_normalize
-from distributed_active_learning_tpu.ops.trees import PackedForest, predict_leaves
+from distributed_active_learning_tpu.ops.trees import PackedForest
 from distributed_active_learning_tpu.parallel import mesh as mesh_lib
 from distributed_active_learning_tpu.parallel.collectives import vector_accumulate
 from distributed_active_learning_tpu.runtime.state import PoolState
@@ -38,29 +38,36 @@ def sharded_votes(mesh: Mesh):
     then one psum over ``model`` completes the vote reduction — the collective
     form of ``groupByKey().mapValues(sum)`` (``uncertainty_sampling.py:96``).
 
+    Works for every forest representation (gather ``PackedForest``, path-matrix
+    ``GemmForest``, fused ``PallasForest``): all array fields carry the tree
+    axis first, so one pytree of ``P(model, ...)`` specs shards any of them,
+    and inside the shard_map body each device evaluates its local shard with
+    the forest's own kernel — including ``pallas_call``, which sees plain
+    local shapes here (no GSPMD partitioning rule needed, unlike the
+    auto-sharded round).
+
     Returns a function ``(forest, x) -> votes [n]``.
     """
+    from distributed_active_learning_tpu.ops import forest_eval
 
-    tree_spec = P(mesh_lib.AXIS_MODEL, None)
+    def votes_fn(forest, x: jnp.ndarray) -> jnp.ndarray:
+        tree_specs = mesh_lib.forest_tree_specs(forest)
 
-    def votes_fn(forest: PackedForest, x: jnp.ndarray) -> jnp.ndarray:
         @functools.partial(
             jax.shard_map,
             mesh=mesh,
-            in_specs=(tree_spec,) * 5 + (P(mesh_lib.AXIS_DATA, None),),
+            in_specs=(tree_specs, P(mesh_lib.AXIS_DATA, None)),
             out_specs=P(mesh_lib.AXIS_DATA),
+            # pallas_call declares its out_shape without a varying-mesh-axes
+            # annotation; skip the vma check (the psum below states the
+            # cross-axis contract explicitly).
+            check_vma=False,
         )
-        def kernel(feature, threshold, left, right, value, x_blk):
-            shard = PackedForest(
-                feature=feature, threshold=threshold, left=left, right=right,
-                value=value, max_depth=forest.max_depth,
-            )
-            local = jnp.sum(predict_leaves(shard, x_blk) > 0.5, axis=1)
+        def kernel(f_local, x_blk):
+            local = jnp.sum(forest_eval.leaves(f_local, x_blk) > 0.5, axis=1)
             return vector_accumulate(local.astype(jnp.int32), mesh_lib.AXIS_MODEL)
 
-        return kernel(
-            forest.feature, forest.threshold, forest.left, forest.right, forest.value, x
-        )
+        return kernel(forest, x)
 
     return votes_fn
 

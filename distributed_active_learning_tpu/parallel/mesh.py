@@ -78,16 +78,25 @@ def shard_pool_state(state: PoolState, mesh: Mesh) -> PoolState:
     )
 
 
-def shard_forest(forest, mesh: Mesh):
-    """Place a forest with trees sharded over the model axis.
+def forest_tree_specs(forest):
+    """Per-leaf PartitionSpecs sharding a forest's tree axis over ``model``.
 
-    Works for both device representations (gather ``PackedForest`` and MXU
-    ``GemmForest``): every array field carries the tree axis first, so each
-    leaf is sharded ``P(model, None, ...)`` to its rank.
+    The one source of the "tree axis first, rest replicated" rule — used both
+    to place forests (:func:`shard_forest`) and as ``shard_map`` in_specs
+    (``parallel.kernels.sharded_votes``). Every array field of every
+    representation (gather ``PackedForest``, path-matrix ``GemmForest``,
+    fused ``PallasForest``) carries the tree axis first.
     """
+    return jax.tree.map(
+        lambda leaf: P(AXIS_MODEL, *([None] * (leaf.ndim - 1))), forest
+    )
 
-    def place(leaf):
-        spec = P(AXIS_MODEL, *([None] * (leaf.ndim - 1)))
-        return jax.device_put(leaf, NamedSharding(mesh, spec))
 
-    return jax.tree.map(place, forest)
+def shard_forest(forest, mesh: Mesh):
+    """Place a forest with trees sharded over the model axis."""
+    specs = forest_tree_specs(forest)
+    return jax.tree.map(
+        lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
+        forest,
+        specs,
+    )

@@ -53,6 +53,12 @@ def build_parser() -> argparse.ArgumentParser:
         "default), pallas (fused VMEM kernel, ~2.5x faster scoring; bf16 "
         "feature compares), gather (traversal form)",
     )
+    ap.add_argument(
+        "--fit", choices=["host", "device"], default="host",
+        help="forest training: host (sklearn on the labeled subset, the "
+        "JVM-fit equivalent) or device (jitted histogram trainer; the whole "
+        "round runs as device programs)",
+    )
     ap.add_argument("--n-start", type=int, default=10)
     ap.add_argument("--rounds", type=int, default=None)
     ap.add_argument("--budget", type=int, default=None, help="stop at N labeled")
@@ -180,7 +186,9 @@ def main(argv=None) -> int:
             n_samples=args.n_samples,
             seed=args.seed,
         ),
-        forest=ForestConfig(n_trees=args.trees, max_depth=args.depth, kernel=args.kernel),
+        forest=ForestConfig(
+            n_trees=args.trees, max_depth=args.depth, kernel=args.kernel, fit=args.fit
+        ),
         strategy=StrategyConfig(
             name=args.strategy,
             window_size=args.window,

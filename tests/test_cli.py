@@ -115,6 +115,19 @@ def test_cli_pallas_kernel_with_mesh_falls_back(capsys):
     assert len(lines) == 2
 
 
+def test_cli_device_fit(capsys):
+    """--fit device runs the on-device histogram trainer end-to-end."""
+    rc = main([
+        "--dataset", "checkerboard2x2", "--strategy", "uncertainty",
+        "--window", "25", "--rounds", "2", "--quiet", "--json",
+        "--fit", "device", "--trees", "6", "--depth", "4",
+    ])
+    assert rc == 0
+    lines = [json.loads(l) for l in capsys.readouterr().out.strip().splitlines()]
+    assert len(lines) == 2 and lines[-1]["n_labeled"] == 35
+    assert all(0.0 <= r["accuracy"] <= 1.0 for r in lines)
+
+
 def test_cli_half_checkpoint_request_rejected():
     """--checkpoint-dir without --checkpoint-every (or vice versa) would be
     silently ignored by both loops — refuse it instead."""

@@ -62,6 +62,25 @@ def test_sharded_votes_matches_single_device(devices, setup):
     np.testing.assert_array_equal(got, want)
 
 
+@pytest.mark.parametrize("kernel", ["gemm", "pallas"])
+def test_sharded_votes_path_matrix_kernels(devices, setup, kernel):
+    """The generic shard_map votes kernel shards the path-matrix forests too
+    (trees over model, pool over data) — including the fused Pallas kernel,
+    which inside shard_map sees plain local shapes. Sharding must not change
+    the kernel's own answer: sharded == unsharded for the SAME kernel (vote
+    counts are small exact integers, so block decomposition cannot drift)."""
+    from distributed_active_learning_tpu.ops import forest_eval
+
+    forest, state = setup
+    mesh = make_mesh(data=4, model=2)
+    sv = jax.jit(sharded_votes(mesh))
+    x_sh = jax.device_put(state.x, NamedSharding(mesh, P("data", None)))
+    f = forest_eval.for_kernel(forest, kernel)
+    got = np.asarray(sv(shard_forest(f, mesh), x_sh))
+    want = np.asarray(forest_eval.votes(f, state.x))
+    np.testing.assert_array_equal(got, want)
+
+
 def test_sharded_mass_matches_single_device(devices, setup):
     _, state = setup
     mesh = make_mesh(data=8, model=1)
