@@ -35,7 +35,7 @@ _SCORES: Dict[str, Callable[[jnp.ndarray], jnp.ndarray]] = {
 
 def _deep_names():
     """The one source of truth for valid deep-strategy (bare) names."""
-    return set(_SCORES) | {"batchbald", "random"}
+    return set(_SCORES) | {"batchbald", "random", "coreset"}
 
 
 def available_deep_strategies():
@@ -238,6 +238,17 @@ def run_neural_experiment(
             if strat == "random":
                 scores = jax.random.uniform(k_rand, (state.n_pool,))
                 _, picked = select_top_k(scores, unlabeled, cfg.window_size)
+            elif strat == "coreset":
+                # Model-free k-Center-Greedy over (flattened) pool features.
+                # Centers = real labeled rows; mesh-padding sentinels (zero
+                # features) are neither centers nor selectable.
+                centers = state.labeled_mask
+                if state.n_valid != state.n_pool:
+                    centers = centers & state.valid_mask
+                picked, _ = deep.coreset_select(
+                    pool_x, centers, cfg.window_size,
+                    selectable_mask=unlabeled,
+                )
             elif strat == "batchbald":
                 probs = learner.predict_proba_samples(net_state, pool_x, k_mc)
                 n_unlabeled = n_pool - n_labeled

@@ -12,9 +12,13 @@ are capability extensions following the standard definitions:
                       falling back to BALD for any remaining picks
 - mean-std            mean over classes of std over posterior samples
 - variation ratios    1 - max_c E_s p
+- coreset             k-Center-Greedy batch diversity (Sener & Savarese 2018)
+                      over pool features — the model-free diversity
+                      counterpart of the uncertainty family
 
-All are pure functions of ``probs_samples [S, n, C]`` and jit-friendly except
-the BatchBALD greedy loop, whose trip count ``k`` is static per window size.
+All are pure functions of ``probs_samples [S, n, C]`` (coreset: of the pool
+features) and jit-friendly; the BatchBALD/coreset greedy loops have static
+trip counts per window size.
 """
 
 from __future__ import annotations
@@ -132,3 +136,67 @@ def batchbald_select(
             joint = (joint[:, :, None] * p_j[:, None, :]).reshape(S, -1)
 
     return jnp.stack(picked), jnp.stack(scores)
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def coreset_select(
+    features: jnp.ndarray,
+    labeled_mask: jnp.ndarray,
+    k: int,
+    chunk: int = 512,
+    selectable_mask: jnp.ndarray | None = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """k-Center-Greedy batch selection (Sener & Savarese 2018).
+
+    Repeatedly picks the unlabeled point farthest (squared L2) from the
+    current center set (labeled points + picks so far) — pure diversity, no
+    posterior needed, so it complements the uncertainty family when MC
+    estimates are unreliable (tiny labeled sets, early rounds). This variant
+    runs on raw pool features (flattened), the embedding-free form.
+
+    ``labeled_mask`` marks the center set; ``selectable_mask`` (default
+    ``~labeled_mask``) marks pickable rows — pass it explicitly when some
+    rows are neither (mesh-padding sentinels: zero features must not act as
+    centers covering the origin, nor be picked).
+
+    TPU shape: the O(n²) init ("distance to nearest labeled center") streams
+    in ``[chunk, n]`` Gram blocks via ``lax.map`` — one MXU matmul per block,
+    never materializing n² — and each of the ``k`` greedy picks is a rank-1
+    distance update + masked argmax, unrolled under jit like BatchBALD.
+
+    Returns ``(picked_idx [k], distance_at_pick [k])``.
+    """
+    n = features.shape[0]
+    x = features.reshape(n, -1).astype(jnp.float32)
+    norms = jnp.sum(x * x, axis=1)  # [n]
+
+    col_inf = jnp.where(labeled_mask, 0.0, jnp.inf)  # +inf hides unlabeled cols
+
+    def init_chunk(args):
+        xc, nc = args
+        g = nc[:, None] + norms[None, :] - 2.0 * (xc @ x.T)  # [chunk, n]
+        return jnp.min(g + col_inf[None, :], axis=1)
+
+    pad = (-n) % chunk
+    xp = jnp.pad(x, ((0, pad), (0, 0)))
+    np_ = jnp.pad(norms, (0, pad))
+    min_dist = jax.lax.map(
+        init_chunk, (xp.reshape(-1, chunk, x.shape[1]), np_.reshape(-1, chunk))
+    ).reshape(-1)[:n]
+    # No labeled centers at all: every point is infinitely far; fall back to
+    # uniform distances so argmax degenerates to a deterministic first pick.
+    min_dist = jnp.where(jnp.isfinite(min_dist), min_dist, norms.max() + 1.0)
+
+    selectable = ~labeled_mask if selectable_mask is None else selectable_mask
+    picked = []
+    dists = []
+    for _ in range(k):
+        d = jnp.where(selectable, min_dist, -jnp.inf)
+        j = jnp.argmax(d)
+        picked.append(j)
+        dists.append(d[j])
+        selectable = selectable.at[j].set(False)
+        d2_j = norms + norms[j] - 2.0 * (x @ x[j])
+        min_dist = jnp.minimum(min_dist, d2_j)
+
+    return jnp.stack(picked), jnp.stack(dists)

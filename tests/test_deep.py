@@ -161,3 +161,61 @@ def test_batchbald_window16_exact_to_fallback_boundary(key):
     remaining = [i for i in range(120) if i >= 7 and i not in chosen]
     expected_8th = max(remaining, key=lambda i: bald[i])
     assert picked[7] == expected_8th
+
+
+def test_coreset_picks_farthest_cluster_first(key):
+    """k-Center-Greedy: with the labeled center in cluster A, the first pick
+    must come from cluster B (the farthest region), and subsequent picks
+    spread coverage instead of piling into one cluster."""
+    a = jax.random.normal(key, (30, 2)) * 0.1
+    b = jax.random.normal(jax.random.fold_in(key, 1), (30, 2)) * 0.1 + 10.0
+    x = jnp.concatenate([a, b])
+    labeled = jnp.zeros(60, bool).at[0].set(True)  # one center, cluster A
+    picked, dists = deep.coreset_select(x, labeled, 4)
+    picked = np.asarray(picked)
+    assert picked[0] >= 30  # farthest = cluster B
+    assert len(set(picked.tolist())) == 4
+    assert not labeled[picked].any()
+    # distances at pick are non-increasing (greedy max-min property)
+    d = np.asarray(dists)
+    assert (np.diff(d) <= 1e-5).all()
+
+
+def test_coreset_chunked_init_matches_small_pool(key):
+    """The lax.map-chunked O(n^2) init must agree with a direct computation:
+    pick sequence identical when chunk > n and chunk < n."""
+    x = jax.random.normal(key, (70, 3))
+    labeled = jnp.zeros(70, bool).at[jnp.array([3, 40])].set(True)
+    p_small, _ = deep.coreset_select(x, labeled, 5, 16)   # chunked (70 > 16)
+    p_big, _ = deep.coreset_select(x, labeled, 5, 512)    # single block
+    np.testing.assert_array_equal(np.asarray(p_small), np.asarray(p_big))
+
+
+def test_coreset_selectable_mask_excludes_padding(key):
+    """Zero-feature padding rows (mesh divisibility sentinels) are neither
+    centers nor selectable when selectable_mask says so."""
+    x = jnp.concatenate([jax.random.normal(key, (20, 2)), jnp.zeros((4, 2))])
+    labeled = jnp.zeros(24, bool).at[0].set(True)
+    selectable = jnp.ones(24, bool).at[0].set(False).at[jnp.arange(20, 24)].set(False)
+    picked, _ = deep.coreset_select(x, labeled, 6, 512, selectable)
+    assert (np.asarray(picked) < 20).all()
+
+
+def test_coreset_runs_in_neural_loop():
+    """deep.coreset is a registry strategy: end-to-end rounds via the neural
+    experiment driver."""
+    from distributed_active_learning_tpu.models.neural import MLP, NeuralLearner
+    from distributed_active_learning_tpu.runtime.neural_loop import (
+        NeuralExperimentConfig,
+        run_neural_experiment,
+    )
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(120, 4)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.int32)
+    cfg = NeuralExperimentConfig(
+        strategy="deep.coreset", window_size=10, n_start=8, max_rounds=2, seed=0
+    )
+    learner = NeuralLearner(MLP(n_classes=2, hidden=(8,)), (4,), train_steps=10, mc_samples=2)
+    res = run_neural_experiment(cfg, learner, x, y, x[:30], y[:30])
+    assert [r.n_labeled for r in res.records] == [8, 18]
