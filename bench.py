@@ -148,6 +148,57 @@ def bench_score(args):
     return result
 
 
+def bench_density(args):
+    """Density-weighted acquisition throughput (BASELINE config 2:
+    credit_card_fraud + density_weighting.py): one-sided vote entropy x
+    similarity mass, scored over the whole unlabeled pool + top-k. The mass
+    uses the O(n·d) matvec identity, so the cost the reference paid as an
+    O(n²·d) BlockMatrix multiply plus an n²-entry shuffle per round
+    (``density_weighting.py:71-75,158-161``) is two matvecs here."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_active_learning_tpu.config import ForestConfig
+    from distributed_active_learning_tpu.models.forest import fit_forest_classifier
+    from distributed_active_learning_tpu.ops import forest_eval
+    from distributed_active_learning_tpu.ops.scoring import positive_entropy
+    from distributed_active_learning_tpu.ops.similarity import similarity_mass
+    from distributed_active_learning_tpu.ops.topk import select_top_k
+
+    rng = np.random.default_rng(0)
+    pool, train_x, train_y = _make_pool(args, rng)
+    forest = forest_eval.for_kernel(
+        fit_forest_classifier(
+            train_x, train_y, ForestConfig(n_trees=args.trees, max_depth=args.depth)
+        ),
+        args.kernel,
+    )
+    pool_dev = jax.device_put(jnp.asarray(pool))
+    unlabeled = jnp.ones(args.pool, dtype=bool)
+    window, beta = args.window, 1.0
+
+    @jax.jit
+    def acquisition(forest, x, mask):
+        votes = forest_eval.votes(forest, x)
+        ent = positive_entropy(votes.astype(jnp.float32) / forest.n_trees)
+        mass = jnp.maximum(similarity_mass(x, mask), 0.0)
+        scores = ent * jnp.power(mass, beta)
+        return select_top_k(scores, mask, window)
+
+    def run():
+        jax.block_until_ready(acquisition(forest, pool_dev, unlabeled))
+
+    run()  # compile
+    sec = _median_time(run, args.iters)
+    scores_per_sec = args.pool / sec
+    return {
+        "density_scores_per_sec": round(scores_per_sec, 1),
+        "vs_baseline": round(
+            scores_per_sec / (SPARK_TREE_POINTS_PER_SEC / args.trees), 1
+        ),
+    }
+
+
 def bench_round(args):
     """One full AL round: fit + score + select + reveal (device and host fit)."""
     import jax
@@ -336,7 +387,9 @@ def bench_lal(args):
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--mode", choices=["all", "score", "round", "lal"], default="all")
+    ap.add_argument(
+        "--mode", choices=["all", "score", "density", "round", "lal"], default="all"
+    )
     ap.add_argument("--pool", type=int, default=284_807)  # credit-card fraud rows
     ap.add_argument("--features", type=int, default=30)
     ap.add_argument("--trees", type=int, default=100)  # mllib/credit_card_fraud.py:35
@@ -362,6 +415,14 @@ def main():
             "vs_baseline": r["vs_baseline"],
             **{k: v for k, v in r.items() if k not in ("value", "vs_baseline", "kernel")},
         }))
+    elif args.mode == "density":
+        r = bench_density(args)
+        print(json.dumps({
+            "metric": "density_scores_per_sec",
+            "value": r["density_scores_per_sec"],
+            "unit": f"scores/s (entropy x similarity mass, {args.pool}x{args.features} pool, {args.trees} trees)",
+            "vs_baseline": r["vs_baseline"],
+        }))
     elif args.mode == "round":
         r = bench_round(args)
         print(json.dumps({
@@ -384,6 +445,7 @@ def main():
         }))
     else:
         s = bench_score(args)
+        d = bench_density(args)
         rd = bench_round(args)
         ll = bench_lal(args)
         print(json.dumps({
@@ -394,6 +456,7 @@ def main():
             "mfu": s.get("mfu"),
             "achieved_tflops": s.get("achieved_tflops"),
             "chip": s.get("chip"),
+            "density_scores_per_sec": d["density_scores_per_sec"],
             "round_seconds": rd["round_seconds"],
             "round_seconds_host_fit": rd["round_seconds_host_fit"],
             "round_vs_spark_derived": rd["vs_baseline"],
