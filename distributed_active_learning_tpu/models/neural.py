@@ -41,7 +41,7 @@ class SmallCNN(nn.Module):
     dropout_rate: float = 0.25
 
     @nn.compact
-    def __call__(self, x, train: bool = False):
+    def __call__(self, x, train: bool = False, return_features: bool = False):
         for feats in (32, 64):
             x = nn.Conv(feats, (3, 3))(x)
             x = nn.relu(x)
@@ -52,6 +52,11 @@ class SmallCNN(nn.Module):
         x = nn.Dense(128)(x)
         x = nn.relu(x)
         x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        if return_features:
+            # Penultimate representation (BADGE/embedding acquisition). The
+            # head Dense is created after this return, so init (which runs the
+            # default path) owns every parameter either way.
+            return x
         return nn.Dense(self.n_classes)(x)
 
 
@@ -64,11 +69,13 @@ class MLP(nn.Module):
     dropout_rate: float = 0.2
 
     @nn.compact
-    def __call__(self, x, train: bool = False):
+    def __call__(self, x, train: bool = False, return_features: bool = False):
         for h in self.hidden:
             x = nn.Dense(h)(x)
             x = nn.relu(x)
             x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        if return_features:
+            return x
         return nn.Dense(self.n_classes)(x)
 
 
@@ -155,6 +162,17 @@ class NeuralLearner:
         """Deterministic class probabilities ``[n, C]`` (dropout off)."""
         def chunk_apply(xc):
             return nn.softmax(self.module.apply({"params": state.params}, xc, train=False))
+
+        return _chunked(chunk_apply, x, self.predict_chunk)
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def embed(self, state: TrainState, x: jnp.ndarray) -> jnp.ndarray:
+        """Penultimate-layer representation ``[n, D]`` (dropout off) — the
+        feature space for embedding-based acquisition (BADGE, coreset)."""
+        def chunk_apply(xc):
+            return self.module.apply(
+                {"params": state.params}, xc, train=False, return_features=True
+            )
 
         return _chunked(chunk_apply, x, self.predict_chunk)
 

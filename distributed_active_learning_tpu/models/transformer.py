@@ -71,7 +71,7 @@ class TransformerClassifier(nn.Module):
     attention_fn: Callable = staticmethod(full_attention)
 
     @nn.compact
-    def __call__(self, ids, train: bool = False):
+    def __call__(self, ids, train: bool = False, return_features: bool = False):
         ids = ids.astype(jnp.int32)
         T = ids.shape[1]
         if T > self.max_len:
@@ -89,4 +89,9 @@ class TransformerClassifier(nn.Module):
             )(x, train=train)
         x = nn.LayerNorm()(x)
         pooled = x.mean(axis=1)
+        if return_features:
+            # Mean-pooled encoder state (BADGE/embedding acquisition); the
+            # head Dense is created after this return — init runs the default
+            # path and owns every parameter.
+            return pooled
         return nn.Dense(self.n_classes)(pooled)

@@ -35,7 +35,7 @@ _SCORES: Dict[str, Callable[[jnp.ndarray], jnp.ndarray]] = {
 
 def _deep_names():
     """The one source of truth for valid deep-strategy (bare) names."""
-    return set(_SCORES) | {"batchbald", "random", "coreset"}
+    return set(_SCORES) | {"batchbald", "random", "coreset", "badge"}
 
 
 def available_deep_strategies():
@@ -248,6 +248,14 @@ def run_neural_experiment(
                 picked, _ = deep.coreset_select(
                     pool_x, centers, cfg.window_size,
                     selectable_mask=unlabeled,
+                )
+            elif strat == "badge":
+                # Hallucinated-gradient k-means++ (deterministic softmax +
+                # penultimate features; D² draws from this round's key).
+                probs = learner.predict_proba(net_state, pool_x)
+                emb = learner.embed(net_state, pool_x)
+                picked = deep.badge_select(
+                    probs, emb, unlabeled, cfg.window_size, k_rand
                 )
             elif strat == "batchbald":
                 probs = learner.predict_proba_samples(net_state, pool_x, k_mc)

@@ -15,6 +15,9 @@ are capability extensions following the standard definitions:
 - coreset             k-Center-Greedy batch diversity (Sener & Savarese 2018)
                       over pool features — the model-free diversity
                       counterpart of the uncertainty family
+- BADGE               k-means++ seeding over hallucinated-label gradient
+                      embeddings g_i ⊗ h_i (Ash et al. 2020), uncertainty x
+                      diversity in one criterion
 
 All are pure functions of ``probs_samples [S, n, C]`` (coreset: of the pool
 features) and jit-friendly; the BatchBALD/coreset greedy loops have static
@@ -200,3 +203,48 @@ def coreset_select(
         min_dist = jnp.minimum(min_dist, d2_j)
 
     return jnp.stack(picked), jnp.stack(dists)
+
+
+@functools.partial(jax.jit, static_argnums=(3,))
+def badge_select(
+    probs: jnp.ndarray,
+    embeddings: jnp.ndarray,
+    selectable_mask: jnp.ndarray,
+    k: int,
+    key: jax.Array,
+) -> jnp.ndarray:
+    """BADGE batch selection (Ash et al. 2020): k-means++ seeding in the
+    space of hallucinated-label gradient embeddings.
+
+    The gradient of cross-entropy w.r.t. the final-layer weights under the
+    model's own predicted label is the rank-1 matrix ``g_i ⊗ h_i`` with
+    ``g_i = p_i − onehot(argmax p_i)`` and ``h_i`` the penultimate features —
+    its norm grows with uncertainty, its direction varies with the input, so
+    D²-weighted k-means++ seeding buys uncertainty AND diversity at once.
+
+    TPU shape: the ``[n, C·D]`` embedding is never materialized — inner
+    products factorize, ``⟨g_i⊗h_i, g_j⊗h_j⟩ = ⟨g_i,g_j⟩·⟨h_i,h_j⟩``, so each
+    of the ``k`` unrolled picks costs two matvecs (one [n,C], one [n,D]) and
+    an elementwise D² update. The first center is drawn uniformly from the
+    selectable set, then D²-categorical sampling (all draws from ``key``).
+
+    Returns ``picked_idx [k]``.
+    """
+    g = probs - jax.nn.one_hot(jnp.argmax(probs, axis=-1), probs.shape[-1])  # [n, C]
+    h = embeddings.reshape(embeddings.shape[0], -1).astype(jnp.float32)
+    sq = jnp.sum(g * g, axis=1) * jnp.sum(h * h, axis=1)  # |g_i⊗h_i|²
+
+    keys = jax.random.split(key, k)
+    j = jax.random.categorical(keys[0], jnp.where(selectable_mask, 0.0, -jnp.inf))
+    picked = [j]
+    selectable = selectable_mask.at[j].set(False)
+    min_d = sq + sq[j] - 2.0 * (g @ g[j]) * (h @ h[j])
+    for t in range(1, k):
+        w = jnp.where(selectable, jnp.maximum(min_d, 1e-12), 0.0)
+        j = jax.random.categorical(keys[t], jnp.log(w))  # log 0 = -inf: masked
+        picked.append(j)
+        selectable = selectable.at[j].set(False)
+        d2_j = sq + sq[j] - 2.0 * (g @ g[j]) * (h @ h[j])
+        min_d = jnp.minimum(min_d, d2_j)
+
+    return jnp.stack(picked)

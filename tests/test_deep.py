@@ -219,3 +219,61 @@ def test_coreset_runs_in_neural_loop():
     learner = NeuralLearner(MLP(n_classes=2, hidden=(8,)), (4,), train_steps=10, mc_samples=2)
     res = run_neural_experiment(cfg, learner, x, y, x[:30], y[:30])
     assert [r.n_labeled for r in res.records] == [8, 18]
+
+
+def test_badge_select_structure(key):
+    """BADGE picks are distinct, selectable-only, and deterministic per key;
+    the factorized distances equal the explicit outer-product embedding's."""
+    n, C, D = 50, 3, 8
+    probs = jax.nn.softmax(jax.random.normal(key, (n, C)) * 2.0, axis=-1)
+    emb = jax.random.normal(jax.random.fold_in(key, 1), (n, D))
+    selectable = jnp.ones(n, bool).at[:5].set(False)
+    picked = np.asarray(deep.badge_select(probs, emb, selectable, 6, jax.random.key(7)))
+    assert len(set(picked.tolist())) == 6
+    assert (picked >= 5).all()
+    again = np.asarray(deep.badge_select(probs, emb, selectable, 6, jax.random.key(7)))
+    np.testing.assert_array_equal(picked, again)
+    # Factorization check: |g_i (x) h_i - g_j (x) h_j|^2 via explicit embedding
+    g = np.asarray(probs - jax.nn.one_hot(jnp.argmax(probs, -1), C))
+    full = (g[:, :, None] * np.asarray(emb)[:, None, :]).reshape(n, -1)
+    i, j = int(picked[0]), int(picked[1])
+    explicit = float(np.sum((full[i] - full[j]) ** 2))
+    sq = np.sum(g * g, 1) * np.sum(np.asarray(emb) ** 2, 1)
+    factored = float(
+        sq[i] + sq[j] - 2.0 * float(g[i] @ g[j]) * float(np.asarray(emb)[i] @ np.asarray(emb)[j])
+    )
+    np.testing.assert_allclose(factored, explicit, rtol=1e-5)
+
+
+def test_badge_runs_in_neural_loop():
+    from distributed_active_learning_tpu.models.neural import MLP, NeuralLearner
+    from distributed_active_learning_tpu.runtime.neural_loop import (
+        NeuralExperimentConfig,
+        run_neural_experiment,
+    )
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(120, 4)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.int32)
+    cfg = NeuralExperimentConfig(
+        strategy="deep.badge", window_size=10, n_start=8, max_rounds=2, seed=0
+    )
+    learner = NeuralLearner(MLP(n_classes=2, hidden=(8,)), (4,), train_steps=10, mc_samples=2)
+    res = run_neural_experiment(cfg, learner, x, y, x[:30], y[:30])
+    assert [r.n_labeled for r in res.records] == [8, 18]
+
+
+def test_embed_returns_penultimate_features():
+    """NeuralLearner.embed reuses the trained params (head created after the
+    feature return, so the param tree is unchanged) and yields [n, D]."""
+    import jax as _jax
+
+    from distributed_active_learning_tpu.models.neural import MLP, NeuralLearner
+
+    learner = NeuralLearner(MLP(n_classes=2, hidden=(16, 8)), (4,), train_steps=5)
+    st = learner.init(_jax.random.key(0))
+    x = jnp.ones((7, 4))
+    emb = learner.embed(st, x)
+    assert emb.shape == (7, 8)  # last hidden width
+    probs = learner.predict_proba(st, x)
+    assert probs.shape == (7, 2)
