@@ -287,6 +287,7 @@ def _run_neural(args, dbg):
         seed=args.seed,
         batchbald_max_configs=args.batchbald_max_configs,
         batchbald_candidate_pool=args.candidate_pool,
+        beta=args.beta,
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every=args.checkpoint_every,
         mesh=MeshConfig(data=args.mesh_data, model=args.mesh_model),

@@ -35,7 +35,7 @@ _SCORES: Dict[str, Callable[[jnp.ndarray], jnp.ndarray]] = {
 
 def _deep_names():
     """The one source of truth for valid deep-strategy (bare) names."""
-    return set(_SCORES) | {"batchbald", "random", "coreset", "badge"}
+    return set(_SCORES) | {"batchbald", "random", "coreset", "badge", "density"}
 
 
 def available_deep_strategies():
@@ -66,6 +66,9 @@ class NeuralExperimentConfig:
     # Greedy BatchBALD candidates (top-k unlabeled by marginal BALD); larger
     # pools are truncated to this many — logged when it happens.
     batchbald_candidate_pool: int = 512
+    # Information-density exponent (deep.density: entropy x mass**beta, the
+    # neural form of density_weighting.py's beta at :33).
+    beta: float = 1.0
     # Same persistence + distribution knobs as the forest ExperimentConfig
     # (round-2 gap: the neural path was a parallel universe with neither).
     checkpoint_dir: Optional[str] = None
@@ -93,6 +96,7 @@ def neural_fingerprint(
         "seed": cfg.seed,
         "retrain_from_scratch": cfg.retrain_from_scratch,
         "batchbald": (cfg.batchbald_max_configs, cfg.batchbald_candidate_pool),
+        "beta": cfg.beta,
         # flax modules are dataclasses: repr() pins the architecture + sizes.
         "module": repr(learner.module),
         "input_shape": learner.input_shape,
@@ -249,6 +253,22 @@ def run_neural_experiment(
                     pool_x, centers, cfg.window_size,
                     selectable_mask=unlabeled,
                 )
+            elif strat == "density":
+                # Information density, neural form (BASELINE config 4:
+                # "entropy + density-weighted"): MC predictive entropy
+                # weighted by cosine-similarity mass over the *learned*
+                # penultimate embeddings (the reference weighted by raw
+                # feature similarity, density_weighting.py:148-168).
+                from distributed_active_learning_tpu.ops.similarity import (
+                    similarity_mass,
+                )
+
+                probs = learner.predict_proba_samples(net_state, pool_x, k_mc)
+                ent = deep.predictive_entropy(probs)
+                emb = learner.embed(net_state, pool_x)
+                mass = jnp.maximum(similarity_mass(emb, unlabeled), 0.0)
+                scores = ent * jnp.power(mass, cfg.beta)
+                _, picked = select_top_k(scores, unlabeled, cfg.window_size)
             elif strat == "badge":
                 # Hallucinated-gradient k-means++ (deterministic softmax +
                 # penultimate features; D² draws from this round's key).

@@ -277,3 +277,24 @@ def test_embed_returns_penultimate_features():
     assert emb.shape == (7, 8)  # last hidden width
     probs = learner.predict_proba(st, x)
     assert probs.shape == (7, 2)
+
+
+def test_deep_density_runs_in_neural_loop():
+    """deep.density (BASELINE config 4's density-weighted arm): MC entropy
+    weighted by embedding similarity mass, end-to-end via the driver."""
+    from distributed_active_learning_tpu.models.neural import MLP, NeuralLearner
+    from distributed_active_learning_tpu.runtime.neural_loop import (
+        NeuralExperimentConfig,
+        run_neural_experiment,
+    )
+
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(120, 4)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.int32)
+    cfg = NeuralExperimentConfig(
+        strategy="deep.density", window_size=10, n_start=8, max_rounds=2,
+        seed=0, beta=1.0,
+    )
+    learner = NeuralLearner(MLP(n_classes=2, hidden=(8,)), (4,), train_steps=10, mc_samples=2)
+    res = run_neural_experiment(cfg, learner, x, y, x[:30], y[:30])
+    assert [r.n_labeled for r in res.records] == [8, 18]
