@@ -89,6 +89,11 @@ def build_parser() -> argparse.ArgumentParser:
     # drawn from the top --candidate-pool unlabeled points by marginal BALD.
     ap.add_argument("--batchbald-max-configs", type=int, default=4096)
     ap.add_argument("--candidate-pool", type=int, default=512)
+    ap.add_argument(
+        "--coreset-space", choices=["input", "embedding"], default="input",
+        help="deep.coreset feature space: raw pool features or the trained "
+        "network's penultimate representation",
+    )
     ap.add_argument("--hidden", default="128,64", help="MLP hidden sizes (neural mode)")
     # Transformer encoder size (--model transformer)
     ap.add_argument("--d-model", type=int, default=128)
@@ -288,6 +293,7 @@ def _run_neural(args, dbg):
         batchbald_max_configs=args.batchbald_max_configs,
         batchbald_candidate_pool=args.candidate_pool,
         beta=args.beta,
+        coreset_space=args.coreset_space,
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every=args.checkpoint_every,
         mesh=MeshConfig(data=args.mesh_data, model=args.mesh_model),

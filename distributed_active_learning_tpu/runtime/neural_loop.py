@@ -30,6 +30,7 @@ _SCORES: Dict[str, Callable[[jnp.ndarray], jnp.ndarray]] = {
     "bald": deep.bald_score,
     "mean_std": deep.mean_std_score,
     "variation_ratio": deep.variation_ratio,
+    "margin": deep.margin_score,
 }
 
 
@@ -69,6 +70,10 @@ class NeuralExperimentConfig:
     # Information-density exponent (deep.density: entropy x mass**beta, the
     # neural form of density_weighting.py's beta at :33).
     beta: float = 1.0
+    # Feature space for deep.coreset: "input" (raw pool features, model-free)
+    # or "embedding" (the trained network's penultimate representation, the
+    # space Sener & Savarese actually use).
+    coreset_space: str = "input"
     # Same persistence + distribution knobs as the forest ExperimentConfig
     # (round-2 gap: the neural path was a parallel universe with neither).
     checkpoint_dir: Optional[str] = None
@@ -97,6 +102,7 @@ def neural_fingerprint(
         "retrain_from_scratch": cfg.retrain_from_scratch,
         "batchbald": (cfg.batchbald_max_configs, cfg.batchbald_candidate_pool),
         "beta": cfg.beta,
+        "coreset_space": cfg.coreset_space,
         # flax modules are dataclasses: repr() pins the architecture + sizes.
         "module": repr(learner.module),
         "input_shape": learner.input_shape,
@@ -243,14 +249,24 @@ def run_neural_experiment(
                 scores = jax.random.uniform(k_rand, (state.n_pool,))
                 _, picked = select_top_k(scores, unlabeled, cfg.window_size)
             elif strat == "coreset":
-                # Model-free k-Center-Greedy over (flattened) pool features.
+                # k-Center-Greedy over pool features ("input": model-free) or
+                # the trained penultimate representation ("embedding").
                 # Centers = real labeled rows; mesh-padding sentinels (zero
                 # features) are neither centers nor selectable.
+                if cfg.coreset_space == "embedding":
+                    space = learner.embed(net_state, pool_x)
+                elif cfg.coreset_space == "input":
+                    space = pool_x
+                else:
+                    raise ValueError(
+                        f"unknown coreset_space {cfg.coreset_space!r}; "
+                        "use 'input' or 'embedding'"
+                    )
                 centers = state.labeled_mask
                 if state.n_valid != state.n_pool:
                     centers = centers & state.valid_mask
                 picked, _ = deep.coreset_select(
-                    pool_x, centers, cfg.window_size,
+                    space, centers, cfg.window_size,
                     selectable_mask=unlabeled,
                 )
             elif strat == "density":

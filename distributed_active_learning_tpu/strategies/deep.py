@@ -62,6 +62,15 @@ def variation_ratio(probs_samples: jnp.ndarray) -> jnp.ndarray:
     return 1.0 - jnp.max(jnp.mean(probs_samples, axis=0), axis=-1)
 
 
+def margin_score(probs_samples: jnp.ndarray) -> jnp.ndarray:
+    """Negative top-2 margin of the posterior mean, per point [n] (higher =
+    smaller margin = more informative) — the multiclass companion of the
+    binary ``abs(0.5 - p)`` rule the reference ranks ascending."""
+    mean = jnp.mean(probs_samples, axis=0)
+    top2 = jax.lax.top_k(mean, 2)[0]
+    return -(top2[..., 0] - top2[..., 1])
+
+
 def _joint_entropy_candidates(joint: jnp.ndarray, probs: jnp.ndarray) -> jnp.ndarray:
     """H of the joint (chosen-batch, candidate i) for every candidate.
 

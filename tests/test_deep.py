@@ -298,3 +298,34 @@ def test_deep_density_runs_in_neural_loop():
     learner = NeuralLearner(MLP(n_classes=2, hidden=(8,)), (4,), train_steps=10, mc_samples=2)
     res = run_neural_experiment(cfg, learner, x, y, x[:30], y[:30])
     assert [r.n_labeled for r in res.records] == [8, 18]
+
+
+def test_margin_score_prefers_close_calls(key):
+    """deep.margin: negative top-2 gap of the posterior mean — a near-tie
+    must outrank a confident point."""
+    probs = jnp.asarray([
+        [[0.51, 0.49, 0.00], [0.90, 0.05, 0.05]],
+    ])  # [S=1, n=2, C=3]
+    s = np.asarray(deep.margin_score(probs))
+    assert s[0] > s[1]
+    np.testing.assert_allclose(s[0], -(0.51 - 0.49), atol=1e-6)
+
+
+def test_coreset_embedding_space_runs():
+    """coreset_space='embedding' selects in the trained penultimate space."""
+    from distributed_active_learning_tpu.models.neural import MLP, NeuralLearner
+    from distributed_active_learning_tpu.runtime.neural_loop import (
+        NeuralExperimentConfig,
+        run_neural_experiment,
+    )
+
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(120, 4)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.int32)
+    cfg = NeuralExperimentConfig(
+        strategy="deep.coreset", window_size=10, n_start=8, max_rounds=2,
+        seed=0, coreset_space="embedding",
+    )
+    learner = NeuralLearner(MLP(n_classes=2, hidden=(8,)), (4,), train_steps=10, mc_samples=2)
+    res = run_neural_experiment(cfg, learner, x, y, x[:30], y[:30])
+    assert [r.n_labeled for r in res.records] == [8, 18]
