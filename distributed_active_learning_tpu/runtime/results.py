@@ -116,3 +116,36 @@ def plot_result(result: ExperimentResult, path: str, title: str = "") -> str:
     fig.savefig(path, dpi=120)
     plt.close(fig)
     return path
+
+
+def plot_comparison(named_logs, path: str, title: str = "") -> str:
+    """Overlay accuracy-vs-labels curves from reference-format logs.
+
+    ``named_logs``: ``[(label, log_path), ...]`` — each file parsed with
+    :func:`parse_reference_log`. The strategy-vs-control overlay is the
+    reference's experiment-level evidence (distUS vs distRAND curves in
+    ``final_thesis/results/``), which it only ever produced by hand.
+    """
+    import matplotlib
+
+    matplotlib.use("Agg")  # headless
+    import matplotlib.pyplot as plt
+
+    fig, ax = plt.subplots(figsize=(7, 4.5))
+    for label, log_path in named_logs:
+        res = parse_reference_log(open(log_path).read())
+        ax.plot(
+            [r.n_labeled for r in res.records],
+            [r.accuracy * 100 for r in res.records],
+            marker="o", ms=3, label=label,
+        )
+    ax.set_xlabel("labeled points")
+    ax.set_ylabel("test accuracy (%)")
+    ax.grid(True, alpha=0.3)
+    ax.legend()
+    if title:
+        ax.set_title(title)
+    fig.tight_layout()
+    fig.savefig(path, dpi=120)
+    plt.close(fig)
+    return path

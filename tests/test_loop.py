@@ -182,3 +182,16 @@ def test_checkpoint_strategy_mismatch_raises(tmp_path):
     bad = _cfg(strategy="random", max_rounds=1, checkpoint_dir=ckpt, checkpoint_every=1)
     with pytest.raises(ValueError, match="fingerprint"):
         run_experiment(bad)
+
+
+def test_plot_comparison_writes_png(tmp_path):
+    """Strategy-vs-control curve overlay from reference-format logs."""
+    from distributed_active_learning_tpu.runtime.results import plot_comparison
+
+    log = tmp_path / "a.txt"
+    log.write_text(
+        "labeled =  10  unlabeled =  990\nIteration  1  -- accu =  80.00\n"
+        "labeled =  20  unlabeled =  980\nIteration  2  -- accu =  85.00\n"
+    )
+    out = plot_comparison([("a", str(log)), ("b", str(log))], str(tmp_path / "c.png"))
+    assert open(out, "rb").read(8).startswith(b"\x89PNG")
