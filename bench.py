@@ -385,11 +385,73 @@ def bench_lal(args):
     }
 
 
+def bench_neural(args):
+    """One deep-AL round's wall-clock for the BASELINE stretch configs:
+    config 4 (CIFAR-shaped pool, SmallCNN, MC-dropout entropy) and config 5
+    (AG-News-shaped token pool, transformer encoder, BatchBALD). The
+    reference never reached these, so the numbers are absolute (no
+    vs_baseline): train train_steps minibatches + MC acquire + reveal.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_active_learning_tpu.data.synthetic import (
+        make_synthetic_images,
+        make_synthetic_tokens,
+    )
+    from distributed_active_learning_tpu.models.neural import NeuralLearner, SmallCNN
+    from distributed_active_learning_tpu.models.transformer import TransformerClassifier
+    from distributed_active_learning_tpu.ops.topk import select_top_k
+    from distributed_active_learning_tpu.strategies import deep
+
+    def one_round_seconds(learner, x, y, strat, window):
+        n = x.shape[0]
+        mask = jnp.zeros(n, bool).at[: args.window].set(True)
+        net = learner.init(jax.random.key(0))
+
+        def run(k):
+            st = learner.fit_on_mask(net, x, y, mask, jax.random.fold_in(k, 1))
+            probs = learner.predict_proba_samples(st, x, jax.random.fold_in(k, 2))
+            if strat == "batchbald":
+                picked, _ = deep.batchbald_select(probs, ~mask, window, 4096, 512)
+            else:
+                _, picked = select_top_k(deep.predictive_entropy(probs), ~mask, window)
+            jax.block_until_ready(picked)
+
+        run(jax.random.key(1))  # compile
+        return _median_time(lambda: run(jax.random.key(2)), max(args.iters // 2, 2))
+
+    kx, kt = jax.random.split(jax.random.key(0))
+    ix, iy = make_synthetic_images(kx, args.neural_pool)
+    cnn = NeuralLearner(
+        SmallCNN(n_classes=10), (32, 32, 3),
+        train_steps=args.train_steps, mc_samples=args.mc_samples,
+    )
+    cnn_sec = one_round_seconds(cnn, jnp.asarray(ix), jnp.asarray(iy), "entropy", 100)
+
+    tx, ty = make_synthetic_tokens(kt, args.neural_pool)
+    enc = NeuralLearner(
+        TransformerClassifier(vocab_size=4096, max_len=64, n_classes=4),
+        (64,), train_steps=args.train_steps, mc_samples=args.mc_samples,
+    )
+    enc_sec = one_round_seconds(enc, jnp.asarray(tx), jnp.asarray(ty), "batchbald", 50)
+
+    return {
+        "cnn_round_seconds": round(cnn_sec, 4),
+        "transformer_batchbald_round_seconds": round(enc_sec, 4),
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument(
-        "--mode", choices=["all", "score", "density", "round", "lal"], default="all"
+        "--mode",
+        choices=["all", "score", "density", "round", "lal", "neural"],
+        default="all",
     )
+    ap.add_argument("--neural-pool", type=int, default=2000)
+    ap.add_argument("--train-steps", type=int, default=300)
+    ap.add_argument("--mc-samples", type=int, default=8)
     ap.add_argument("--pool", type=int, default=284_807)  # credit-card fraud rows
     ap.add_argument("--features", type=int, default=30)
     ap.add_argument("--trees", type=int, default=100)  # mllib/credit_card_fraud.py:35
@@ -422,6 +484,15 @@ def main():
             "value": r["density_scores_per_sec"],
             "unit": f"scores/s (entropy x similarity mass, {args.pool}x{args.features} pool, {args.trees} trees)",
             "vs_baseline": r["vs_baseline"],
+        }))
+    elif args.mode == "neural":
+        r = bench_neural(args)
+        print(json.dumps({
+            "metric": "neural_round_seconds",
+            "value": r["cnn_round_seconds"],
+            "unit": f"s/round (SmallCNN entropy, {args.neural_pool} pool, {args.train_steps} steps, {args.mc_samples} MC)",
+            "vs_baseline": None,
+            "transformer_batchbald_round_seconds": r["transformer_batchbald_round_seconds"],
         }))
     elif args.mode == "round":
         r = bench_round(args)
