@@ -33,13 +33,14 @@ has *data-independent* structure: :func:`heap_gemm_forest` builds a
 :class:`GemmForest` by slicing — no host round-trip — so fit + convert +
 score + select can run as one jitted program.
 
-Measured split of the 0.44 s device AL round (v5e, 284,807x30 pool, 100
-trees, depth 8, 5k labeled window): fit 328 ms, pallas scoring 134 ms. The
-fit's histogram GEMMs ride the MXU in bf16 already; its cost is the
-per-level one-hot row-weight build (memory-bound elementwise), so further
-gains would need an incrementally-maintained node one-hot — noted, not
-taken: the device fit is already 8.5x the host sklearn fit and the whole
-round sits at ~20,000x the derived Spark baseline.
+Measured split of the device AL round (v5e, 284,807x30 pool, 100 trees,
+depth 8, 5k labeled window): fit 275 ms (330 ms before the bf16 row-weight
+build below), pallas scoring 134 ms. The fit's histogram GEMMs ride the MXU
+in bf16; the remaining cost is the per-level one-hot row-weight build
+(memory-bound elementwise), so further gains would need an incrementally-
+maintained node one-hot — noted, not taken: the device fit is already ~10x
+the host sklearn fit and the whole round sits at ~20,000x the derived Spark
+baseline.
 """
 
 from __future__ import annotations
@@ -155,15 +156,20 @@ def fit_forest_device(
         Tc = tree_chunk
         k_boot, k_feat = jax.random.split(k_chunk)
         # Poisson(1) bootstrap weights, zeroed outside the labeled window.
-        w = jax.random.poisson(k_boot, 1.0, (Tc, m)).astype(jnp.float32)
-        w = w * weights[None, :]
+        # bf16 end-to-end: weights are small integers (exact in bf16), and the
+        # [Tc, m, J*C] one-hot build below is memory-bound — halving its bytes
+        # is the measured lever (330 -> 275 ms fit at the bench workload).
+        w = jax.random.poisson(k_boot, 1.0, (Tc, m)).astype(jnp.bfloat16)
+        w = w * weights[None, :].astype(jnp.bfloat16)
         wy = jnp.stack([w * (~y1), w * y1], axis=2)  # [Tc, m, C]
 
         node = jnp.zeros((Tc, m), dtype=jnp.int32)  # level-local node index
         feat_out = []
         thr_out = []
         values = [
-            jnp.sum(wy, axis=1)[:, None, :]  # [Tc, 1, C] root counts
+            # Root counts accumulate ~thousands of weights: sum in f32 (bf16
+            # addition loses integer exactness past 256).
+            jnp.sum(wy.astype(jnp.float32), axis=1)[:, None, :]  # [Tc, 1, C]
         ]
 
         for level in range(D):
