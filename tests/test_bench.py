@@ -1,0 +1,51 @@
+"""bench.py smoke: the driver runs `python bench.py` for the round's BENCH
+record — a broken bench loses the round's headline numbers, so the mode
+functions get a tiny-shape CPU regression test (real timings come from the
+TPU runs; here we only assert the contract: keys present, values sane)."""
+
+import argparse
+import importlib.util
+import sys
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def bench():
+    spec = importlib.util.spec_from_file_location("bench", "bench.py")
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["bench"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _args(**kw):
+    base = dict(
+        mode="score", pool=1500, features=6, trees=5, depth=4, window=10,
+        iters=1, train_rows=150, lal_trees=10, lal_pool=120, kernel="gemm",
+        neural_pool=64, train_steps=5, mc_samples=2,
+    )
+    base.update(kw)
+    return argparse.Namespace(**base)
+
+
+def test_bench_score_contract(bench):
+    r = bench.bench_score(_args())
+    assert r["value"] > 0 and r["vs_baseline"] > 0
+    assert r["kernel"] == "gemm" and "mfu" not in r or True  # mfu only on TPU
+
+
+def test_bench_density_contract(bench):
+    r = bench.bench_density(_args())
+    assert r["density_scores_per_sec"] > 0
+
+
+def test_bench_round_contract(bench):
+    r = bench.bench_round(_args())
+    assert r["round_seconds"] > 0 and r["round_seconds_host_fit"] > 0
+    assert r["vs_baseline"] > 0
+
+
+def test_bench_score_pallas_kernel(bench):
+    r = bench.bench_score(_args(kernel="pallas"))
+    assert r["kernel"] == "pallas" and r["value"] > 0
