@@ -122,12 +122,9 @@ def bench_score(args):
         jax.block_until_ready(out)
 
     run()  # compile
-    times = []
-    for _ in range(args.iters):
-        t0 = time.perf_counter()
-        run()
-        times.append(time.perf_counter() - t0)
-    scores_per_sec = args.pool / min(times)
+    # Median, like every other mode (r3 used min here — best-case vs the
+    # typical-case numbers elsewhere was inconsistent methodology).
+    scores_per_sec = args.pool / _median_time(run, args.iters)
 
     result = {
         "value": round(scores_per_sec, 1),
@@ -241,13 +238,18 @@ def bench_round(args):
         and fc.max_depth <= forest_eval._GEMM_MAX_DEPTH
     )
 
-    @jax.jit
-    def device_round(codes, y, mask, key):
+    def fit_heap(codes, y, mask, key):
+        # Single definition of the round's fit half, shared by the fused
+        # round and the phase-split timing below so they cannot drift.
         c, yy, w = trees_train.gather_fit_window(codes, y, mask, budget)
-        f, th, v = trees_train.fit_forest_device(
+        return trees_train.fit_forest_device(
             c, yy, w, binned.edges, key,
             n_trees=fc.n_trees, max_depth=fc.max_depth, n_bins=fc.max_bins,
         )
+
+    @jax.jit
+    def device_round(codes, y, mask, key):
+        f, th, v = fit_heap(codes, y, mask, key)
         if to_gemm:
             forest = trees_train.heap_gemm_forest(f, th, v, fc.max_depth)
             if args.kernel == "pallas":
@@ -268,6 +270,17 @@ def bench_round(args):
     run_device()  # compile
     device_sec = _median_time(run_device, args.iters)
 
+    # Phase split: time the fit and the score/select as separate programs so
+    # the JSON records where the round goes (fused round_seconds can be
+    # slightly under fit+score since XLA overlaps the stages).
+    device_fit_only = jax.jit(fit_heap)
+
+    def run_fit():
+        jax.block_until_ready(device_fit_only(binned.codes, y_dev, mask_dev, key))
+
+    run_fit()  # compile
+    fit_sec = _median_time(run_fit, args.iters)
+
     # --- host (sklearn) fit round: the round-2 status quo, for comparison.
     def run_host():
         lx, ly = pool[mask0], pool_y[mask0]
@@ -281,6 +294,8 @@ def bench_round(args):
     spark_round_sec = args.pool * args.trees / SPARK_TREE_POINTS_PER_SEC
     return {
         "round_seconds": round(device_sec, 4),
+        "round_fit_seconds": round(fit_sec, 4),
+        "round_score_seconds": round(max(device_sec - fit_sec, 0.0), 4),
         "round_seconds_host_fit": round(host_sec, 4),
         "vs_baseline": round(spark_round_sec / device_sec, 1),
         "spark_round_seconds_derived": round(spark_round_sec, 1),
@@ -501,6 +516,8 @@ def main():
             "value": r["round_seconds"],
             "unit": f"s/round (device fit + score + select, {args.pool} pool, {args.trees} trees)",
             "vs_baseline": r["vs_baseline"],
+            "round_fit_seconds": r["round_fit_seconds"],
+            "round_score_seconds": r["round_score_seconds"],
             "round_seconds_host_fit": r["round_seconds_host_fit"],
             "spark_round_seconds_derived": r["spark_round_seconds_derived"],
         }))
@@ -529,6 +546,8 @@ def main():
             "chip": s.get("chip"),
             "density_scores_per_sec": d["density_scores_per_sec"],
             "round_seconds": rd["round_seconds"],
+            "round_fit_seconds": rd["round_fit_seconds"],
+            "round_score_seconds": rd["round_score_seconds"],
             "round_seconds_host_fit": rd["round_seconds_host_fit"],
             "round_vs_spark_derived": rd["vs_baseline"],
             "lal_query_seconds": ll["lal_query_seconds"],

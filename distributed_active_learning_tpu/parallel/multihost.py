@@ -53,15 +53,25 @@ def initialize(
 def maybe_initialize() -> bool:
     """Initialize iff a multi-host launch is configured; returns whether it was.
 
-    Single-host runs (no coordinator env, one process) skip initialization —
-    calling ``jax.distributed.initialize`` there would start a coordination
+    Two launch contracts engage it: the explicit env trio
+    (``JAX_COORDINATOR_ADDRESS``/``JAX_NUM_PROCESSES``/``JAX_PROCESS_ID``), and
+    Cloud TPU pods, where the runtime auto-detects everything but still needs
+    ``jax.distributed.initialize()`` *called* — detected here via the pod
+    metadata env (multiple entries in ``TPU_WORKER_HOSTNAMES``). Single-host
+    runs skip initialization: calling it there would start a coordination
     service nothing connects to.
     """
     nproc = os.environ.get(_ENV_NPROC)
-    if os.environ.get(_ENV_COORD) is None or nproc is None or int(nproc) <= 1:
-        return False
-    initialize()
-    return True
+    if os.environ.get(_ENV_COORD) is not None and nproc is not None and int(nproc) > 1:
+        initialize()
+        return True
+    # Cloud TPU pod: worker hostnames are provisioned into the env; >1 worker
+    # means multi-host, and initialize() auto-detects coordinator/count/id.
+    workers = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    if len(workers.split(",")) > 1:
+        jax.distributed.initialize()
+        return True
+    return False
 
 
 def is_primary() -> bool:

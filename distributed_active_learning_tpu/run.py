@@ -126,6 +126,14 @@ def main(argv=None) -> int:
     ap = build_parser()
     args = ap.parse_args(argv)
 
+    # Join a multi-host job before any jax device use, iff one is configured
+    # (JAX_COORDINATOR_ADDRESS/...); otherwise a pod launch would run each
+    # host as an independent process-0 job and every host would write
+    # checkpoints/results (the process-0-only gates would never engage).
+    from distributed_active_learning_tpu.parallel import multihost
+
+    multihost.maybe_initialize()
+
     if args.list:
         from distributed_active_learning_tpu.data import available_datasets
         from distributed_active_learning_tpu.runtime.neural_loop import (

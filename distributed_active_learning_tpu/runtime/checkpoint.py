@@ -14,6 +14,15 @@ Format: step-numbered ``.npz`` files (portable, atomic via rename) + the
 records as JSON lines. Masks are stored over *real* pool rows only — mesh
 padding is a placement detail, so a checkpoint written under one ``--mesh-data``
 resumes under any other (the mesh is deliberately absent from fingerprints).
+
+Bit-identical resume holds for same-mesh resumes on both loops, and for
+cross-mesh resumes of the *forest* loop (the sharded round matches the
+unsharded one bit-for-bit, tests/test_parallel.py). Cross-mesh resumes of the
+*neural* loop are legitimate but may diverge from the original curve when the
+pool is not divisible by the data axis: the neural path's per-row RNG draws
+(fit minibatch sampling, dropout, deep.random) are shaped by the padded pool
+length, so a different padding perturbs the draws even though padded rows are
+never selectable.
 """
 
 from __future__ import annotations
@@ -46,6 +55,12 @@ def _forest_ident(cfg, with_mesh: bool) -> dict:
     forest_ident = dataclasses.asdict(cfg.forest)
     # The evaluation kernel is a pure-performance knob (gather/gemm agree
     # bit-for-bit on votes) — switching it between runs is a legitimate resume.
+    # Caveat: the pallas kernel compares features in bfloat16, so for
+    # host-fit forests on float features a gemm<->pallas swap across a resume
+    # can flip a vote whose feature sits within bf16 rounding of a threshold
+    # (~0.4%); device-fit forests compare integer bin codes and are exact
+    # (ops/trees_pallas.py numerics note). Kept out of the identity because
+    # refusing the resume outright would also refuse the exact cases.
     forest_ident.pop("kernel", None)
     ident = {
         "data": dataclasses.asdict(cfg.data),
@@ -219,7 +234,7 @@ def save_neural(
     net_state,
     loop_key: jax.Array,
     fingerprint: Optional[str] = None,
-) -> str:
+) -> Optional[str]:
     """Neural-experiment checkpoint: AL state + network params/optimizer.
 
     Extends :func:`save` with what the neural loop additionally needs to

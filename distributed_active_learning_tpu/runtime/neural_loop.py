@@ -315,8 +315,11 @@ def run_neural_experiment(
                 scores = _SCORES[strat](probs)
                 _, picked = select_top_k(scores, unlabeled, cfg.window_size)
             state = state_lib.reveal(state, picked)
-            acc = learner.accuracy(net_state, test_x, test_y)
+            jax.block_until_ready(state.labeled_mask)
         score_time = dbg.records[-1][1]
+        with dbg.phase("eval"):
+            acc = learner.accuracy(net_state, test_x, test_y)
+        eval_time = dbg.records[-1][1]
 
         # Pre-reveal count: the accuracy was measured on the network trained on
         # this many labels (same record semantics as runtime.loop).
@@ -328,7 +331,8 @@ def run_neural_experiment(
                 accuracy=acc,
                 train_time=train_time,
                 score_time=score_time,
-                total_time=train_time + score_time,
+                eval_time=eval_time,
+                total_time=train_time + score_time + eval_time,
             )
         )
         if (

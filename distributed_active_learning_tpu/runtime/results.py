@@ -22,6 +22,9 @@ class RoundRecord:
     accuracy: float
     train_time: float = 0.0
     score_time: float = 0.0
+    # Test-set evaluation wall-clock, kept out of score_time so the
+    # acquisition timing is pure (rounds logged before r4 folded it in).
+    eval_time: float = 0.0
     total_time: float = 0.0
 
 
