@@ -1,0 +1,535 @@
+"""Measured kernel-variant sweep behind the r4 fused-forest kernel redesign.
+
+r3 shipped ``ops/trees_pallas.py`` at 13.8% MFU and named its own headroom:
+the one-hot selection matmul pads d=30 to 128 lanes, and the per-tree f32
+leaf matvecs ([BN, L] x [L] with one useful output lane of 128) cost as much
+MXU time as the main path GEMM. This script measures candidate fixes on the
+real chip at the BENCH workload (284,807 x 30 pool, 100 trees, depth 8) so
+the production kernel keeps only what the hardware actually rewards:
+
+- v0: r3 production kernel (baseline).
+- v1: transposed layout (x^T streamed, tree-major throughout) + per-tree
+  hi/lo bf16 leaf GEMMs ([8, L] x [L, BN]: full 512 output lanes, exact
+  f32 leaf values via value = hi + lo bf16 split).
+- v2: v1 with the main path GEMM in int8 (c in {0,1}, path in {-1,0,+1}:
+  exact in int8, 2x the bf16 MXU rate on v5e).
+- v3: v2 with the per-tree main GEMMs as one batched dot_general.
+- v4: v2 with the leaf contraction as one block-diagonal [bt, bt*L] GEMM.
+
+Run: python benches/pallas_variants.py [--pool N] [--variants v0,v2,...]
+
+NOTE: v0 calls whatever ``ops/trees_pallas.py`` currently ships — after the
+r4 redesign landed (the "wf" configuration: transposed, int8 main, bigsel,
+f32 leaf rows) v0 *is* that kernel; the r3 baseline it replaced measured
+1.56-1.70M scores/s in the interleaved runs recorded here.
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from distributed_active_learning_tpu.config import ForestConfig
+from distributed_active_learning_tpu.models.forest import fit_forest_classifier
+from distributed_active_learning_tpu.ops import forest_eval
+from distributed_active_learning_tpu.ops.trees_gemm import GemmForest
+from distributed_active_learning_tpu.ops.trees_pallas import (
+    _pad_to,
+    predict_leaves_pallas,
+)
+
+_BN = 512
+_BT = 16
+
+
+# ---------------------------------------------------------------- transposed
+def _prep_transposed(gf: GemmForest, x, bn, bt, int8: bool, leaf_f32=False):
+    """Host/XLA-side packing shared by the transposed variants."""
+    n, d = x.shape
+    T, I = gf.feat_ids.shape
+    L = gf.value.shape[1]
+    i_pad = max(-(-I // 128) * 128, 128)
+    l_pad = max(-(-L // 128) * 128, 128)
+    d_pad = max(-(-d // 128) * 128, 128)
+
+    feat = _pad_to(gf.feat_ids, 1, i_pad)
+    thr = _pad_to(gf.thresholds, 1, i_pad, value=-np.inf)
+    path = _pad_to(_pad_to(gf.path, 1, i_pad), 2, l_pad)
+    tgt = _pad_to(gf.target, 1, l_pad, value=1.0e6)
+    val = _pad_to(gf.value, 1, l_pad)
+
+    feat = _pad_to(feat, 0, bt)
+    thr = _pad_to(thr, 0, bt, value=-np.inf)
+    path = _pad_to(path, 0, bt)
+    tgt = _pad_to(tgt, 0, bt, value=1.0e6)
+    val = _pad_to(val, 0, bt)
+    t_pad = thr.shape[0]
+
+    # One-hot selector, transposed: [t_pad*i_pad, d_pad].
+    selT = jax.nn.one_hot(feat.reshape(-1), d_pad, dtype=jnp.bfloat16)
+    # Transposed pool: [d_pad, n_pad] (one relayout per call, HBM-rate).
+    xT = _pad_to(_pad_to(x.astype(jnp.bfloat16), 1, d_pad), 0, bn).T
+    n_pad = xT.shape[1]
+
+    # Path transposed per tree: [t, l_pad, i_pad]; int8 exact for {-1,0,1}.
+    pathT = jnp.swapaxes(path, 1, 2)
+    pathT = pathT.astype(jnp.int8) if int8 else pathT.astype(jnp.bfloat16)
+    tgt = tgt.astype(jnp.int32) if int8 else tgt
+    if leaf_f32:
+        # Full-precision leaf payload: the one-hot contraction is an exact
+        # f32 gather (hi/lo planes unused; lo rides as zeros).
+        val_hi = val.astype(jnp.float32)
+        val_lo = jnp.zeros_like(val, dtype=jnp.bfloat16)
+    else:
+        # f32 leaf values as two bf16 planes: val == hi + lo to ~2^-17 rel.
+        val_hi = val.astype(jnp.bfloat16)
+        val_lo = (val - val_hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    return dict(
+        xT=xT, selT=selT, thr=thr, pathT=pathT, tgt=tgt,
+        val_hi=val_hi, val_lo=val_lo,
+        dims=(n, n_pad, T, t_pad, i_pad, l_pad, d_pad),
+    )
+
+
+def _kernel_transposed(
+    xT_ref, selT_ref, thr_ref, pathT_ref, tgt_ref, vhi_ref, vlo_ref, out_ref,
+    *, int8: bool, batched: bool, blockdiag: bool, leaf_vpu: bool,
+    ablate: str = "full", fv_bf16: bool = False, main_bf16: bool = False,
+    relu_hit: bool = False, bigsel: bool = False, leaf_f32: bool = False,
+):
+    bt, i_pad = thr_ref.shape
+    l_pad = pathT_ref.shape[1]
+    bn = xT_ref.shape[1]
+    if main_bf16:
+        # Ancestor counts are small ints — exact in bf16; a bf16 main GEMM
+        # spills its [i_pad, BN] output at 2 bytes/elem instead of 4.
+        acc_t = jnp.float32
+        c_t = jnp.bfloat16
+    else:
+        acc_t = jnp.int32 if int8 else jnp.float32
+        c_t = jnp.int8 if int8 else jnp.bfloat16
+    sel3 = selT_ref[:].reshape(bt, i_pad, selT_ref.shape[1])
+
+    if batched:
+        fvT = jax.lax.dot_general(
+            sel3, jnp.broadcast_to(xT_ref[:], (bt,) + xT_ref.shape),
+            dimension_numbers=(((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )  # [bt, i_pad, BN]
+        cT3 = (fvT <= thr_ref[:][:, :, None]).astype(c_t)
+        sT = jax.lax.dot_general(
+            pathT_ref[:], cT3,
+            dimension_numbers=(((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=acc_t,
+        )  # [bt, l_pad, BN]
+        hitT = (sT == tgt_ref[:][:, :, None]).astype(jnp.bfloat16)
+        hits = [hitT[t] for t in range(bt)]
+    else:
+        # Per-tree streaming keeps transients small ([i_pad, BN]-sized):
+        # selection -> compare -> path GEMM -> hit -> leaf, one tree at a
+        # time, so only one tree's intermediates are ever live.
+        fv_all = None
+        if bigsel:
+            fv_all = jnp.dot(
+                selT_ref[:], xT_ref[:], preferred_element_type=jnp.float32
+            )
+            if fv_bf16:
+                fv_all = fv_all.astype(jnp.bfloat16)
+        rows = []
+        for t in range(bt):
+            if bigsel:
+                fvT = fv_all[t * i_pad:(t + 1) * i_pad]
+            else:
+                fvT = jnp.dot(
+                    sel3[t], xT_ref[:], preferred_element_type=jnp.float32
+                )
+                if fv_bf16:
+                    fvT = fvT.astype(jnp.bfloat16)
+            if ablate == "sel":
+                rows.append(fvT[0:1])
+                continue
+            thr_t = thr_ref[t][:, None]
+            # Mosaic crashes on bf16 [N,1]-broadcast compares; compare in f32
+            # (the bf16 round-trip still halves the fvT spill width).
+            cT = (fvT.astype(jnp.float32) <= thr_t).astype(c_t)
+            if ablate == "cmp":
+                rows.append(cT[0:1].astype(jnp.float32))
+                continue
+            sT = jnp.dot(pathT_ref[t], cT, preferred_element_type=acc_t)
+            if main_bf16:
+                sT = sT.astype(jnp.bfloat16)
+            if ablate == "main":
+                rows.append(sT[0:1].astype(jnp.float32))
+                continue
+            if relu_hit:
+                # s <= tgt with equality only at the true leaf, and both are
+                # small integers (|.| <= depth): relu(s - tgt + 1) is the
+                # exact one-hot in any dtype that holds small ints exactly.
+                hit = jax.nn.relu(
+                    sT.astype(jnp.float32) - tgt_ref[t][:, None] + 1.0
+                ).astype(jnp.bfloat16)
+            else:
+                hit = (sT.astype(jnp.float32) == tgt_ref[t][:, None].astype(
+                    jnp.float32)).astype(
+                        jnp.float32 if leaf_f32 else jnp.bfloat16)
+            if ablate == "eq":
+                rows.append(hit[0:1].astype(jnp.float32))
+                continue
+            if leaf_f32:
+                # Exact: hit is a one-hot f32, val rides as a full-precision
+                # f32 row, so the matvec is a gather of the f32 leaf value.
+                rows.append(jnp.dot(vhi_ref[t].reshape(1, l_pad), hit,
+                                    preferred_element_type=jnp.float32))
+            elif leaf_vpu:
+                v32 = vhi_ref[t].astype(jnp.float32) + vlo_ref[t].astype(
+                    jnp.float32)
+                rows.append(jnp.sum(hit.astype(jnp.float32) * v32[:, None],
+                                    axis=0, keepdims=True))
+            else:
+                vhl = jnp.concatenate(
+                    [vhi_ref[t].reshape(1, l_pad), vlo_ref[t].reshape(1, l_pad)],
+                    axis=0,
+                )
+                hl = jnp.dot(vhl, hit, preferred_element_type=jnp.float32)
+                rows.append(hl[0:1] + hl[1:2])
+        out_ref[:] = jnp.concatenate(rows, axis=0)
+        return
+
+    if blockdiag:
+        hit_all = jnp.concatenate(hits, axis=0)  # [bt*l_pad, BN]
+        eye = jax.lax.broadcasted_iota(jnp.int32, (bt, 1, bt), 0) == \
+            jax.lax.broadcasted_iota(jnp.int32, (bt, 1, bt), 2)
+        Vhi = (vhi_ref[:][:, :, None] * eye.astype(jnp.bfloat16)).reshape(
+            bt * l_pad, bt)
+        Vlo = (vlo_ref[:][:, :, None] * eye.astype(jnp.bfloat16)).reshape(
+            bt * l_pad, bt)
+        pred = (
+            jnp.dot(Vhi.T, hit_all, preferred_element_type=jnp.float32)
+            + jnp.dot(Vlo.T, hit_all, preferred_element_type=jnp.float32)
+        )  # [bt, BN]
+        out_ref[:] = pred
+    else:
+        rows = []
+        for t in range(bt):
+            hi = jnp.dot(vhi_ref[t].reshape(1, l_pad), hits[t],
+                         preferred_element_type=jnp.float32)
+            lo = jnp.dot(vlo_ref[t].reshape(1, l_pad), hits[t],
+                         preferred_element_type=jnp.float32)
+            rows.append(hi + lo)
+        out_ref[:] = jnp.concatenate(rows, axis=0)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "bn", "bt", "int8", "batched", "blockdiag", "leaf_vpu", "ablate",
+        "fv_bf16", "main_bf16", "relu_hit", "bigsel", "tree_outer", "leaf_f32",
+        "interpret"
+    ),
+)
+def predict_leaves_transposed(
+    gf: GemmForest, x, bn=_BN, bt=_BT, int8=False, batched=False,
+    blockdiag=False, leaf_vpu=False, ablate="full", fv_bf16=False,
+    main_bf16=False, relu_hit=False, bigsel=False, tree_outer=False,
+    leaf_f32=False, interpret=False,
+):
+    p = _prep_transposed(gf, x, bn, bt, int8, leaf_f32=leaf_f32)
+    n, n_pad, T, t_pad, i_pad, l_pad, d_pad = p["dims"]
+    kern = functools.partial(
+        _kernel_transposed, int8=int8, batched=batched, blockdiag=blockdiag,
+        leaf_vpu=leaf_vpu, ablate=ablate, fv_bf16=fv_bf16,
+        main_bf16=main_bf16, relu_hit=relu_hit, bigsel=bigsel,
+        leaf_f32=leaf_f32,
+    )
+    if tree_outer:
+        # Tree block in the slow grid dim: the per-tree-block inputs (sel,
+        # path, thresholds, leaves) keep a constant index across consecutive
+        # steps, so Pallas skips their re-fetch; only the x tile streams.
+        grid = (t_pad // bt, n_pad // bn)
+        tree_ix = lambda j, i: (j, 0)
+        tree_ix3 = lambda j, i: (j, 0, 0)
+        x_ix = lambda j, i: (0, i)
+        out_ix = lambda j, i: (j, i)
+    else:
+        grid = (n_pad // bn, t_pad // bt)
+        tree_ix = lambda i, j: (j, 0)
+        tree_ix3 = lambda i, j: (j, 0, 0)
+        x_ix = lambda i, j: (0, i)
+        out_ix = lambda i, j: (j, i)
+    out = pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((d_pad, bn), x_ix),
+            pl.BlockSpec((bt * i_pad, d_pad), tree_ix),
+            pl.BlockSpec((bt, i_pad), tree_ix),
+            pl.BlockSpec((bt, l_pad, i_pad), tree_ix3),
+            pl.BlockSpec((bt, l_pad), tree_ix),
+            pl.BlockSpec((bt, l_pad), tree_ix),
+            pl.BlockSpec((bt, l_pad), tree_ix),
+        ],
+        out_specs=pl.BlockSpec((bt, bn), out_ix),
+        out_shape=jax.ShapeDtypeStruct((t_pad, n_pad), jnp.float32),
+        interpret=interpret,
+    )(p["xT"], p["selT"], p["thr"], p["pathT"], p["tgt"], p["val_hi"], p["val_lo"])
+    return out[:T, :n].T
+
+
+# ------------------------------------------------------------ segmented
+def _prep_segmented(gf: GemmForest, x, bn, bt):
+    """Feature-segmented slot layout: node (t, f, rank r) lives at slot
+    f*S + r, so the compare operand is a broadcast-reshape of the x tile
+    (each feature row repeated S times) — no selection matmul at all."""
+    n, d = x.shape
+    T, I = gf.feat_ids.shape
+    L = gf.value.shape[1]
+    l_pad = max(-(-L // 128) * 128, 128)
+    d32 = 32  # feature rows padded to one sublane tile
+    assert d <= d32
+
+    feat = np.asarray(gf.feat_ids)
+    thr_in = np.asarray(gf.thresholds)
+    path_in = np.asarray(gf.path)
+    # S: max nodes sharing a feature within one tree, rounded so i_seg = 32*S
+    # is a lane multiple.
+    S = 1
+    per_tree = []
+    for t in range(T):
+        used = np.where(thr_in[t] > -np.inf)[0]
+        ranks = {}
+        slots = []
+        for i in used:
+            f = int(feat[t, i])
+            r = ranks.get(f, 0)
+            ranks[f] = r + 1
+            slots.append((i, f, r))
+        per_tree.append(slots)
+        if ranks:
+            S = max(S, max(ranks.values()))
+    S = -(-S // 4) * 4
+    i_seg = d32 * S
+
+    t_pad = -(-T // bt) * bt
+    thr = np.full((t_pad, i_seg), -np.inf, dtype=np.float32)
+    path = np.zeros((t_pad, l_pad, i_seg), dtype=np.int8)
+    for t, slots in enumerate(per_tree):
+        for i, f, r in slots:
+            k = f * S + r
+            thr[t, k] = thr_in[t, i]
+            path[t, :path_in.shape[2], k] = path_in[t, i, :].astype(np.int8)
+    tgt = np.asarray(_pad_to(gf.target, 1, l_pad, value=1.0e6))
+    tgt = np.concatenate(
+        [tgt, np.full((t_pad - T, l_pad), 1.0e6, np.float32)], axis=0
+    ).astype(np.int32)
+    val = np.asarray(_pad_to(gf.value, 1, l_pad))
+    val = np.concatenate([val, np.zeros((t_pad - T, l_pad), np.float32)], axis=0)
+    val_hi = val.astype(jnp.bfloat16)
+    val_lo = (val - np.asarray(val_hi, np.float32)).astype(jnp.bfloat16)
+
+    xT = _pad_to(_pad_to(x.astype(jnp.bfloat16), 1, d32), 0, bn).T
+    return dict(
+        xT=xT, thr=jnp.asarray(thr), path=jnp.asarray(path),
+        tgt=jnp.asarray(tgt), val_hi=jnp.asarray(val_hi),
+        val_lo=jnp.asarray(val_lo),
+        dims=(n, xT.shape[1], T, t_pad, i_seg, l_pad, S),
+    )
+
+
+def _kernel_segmented(xT_ref, thr_ref, path_ref, tgt_ref, vhi_ref, vlo_ref,
+                      out_ref, *, S: int):
+    bt, i_seg = thr_ref.shape
+    l_pad = path_ref.shape[1]
+    bn = xT_ref.shape[1]
+    d32 = i_seg // S
+    xr = jnp.broadcast_to(
+        xT_ref[:][:, None, :], (d32, S, bn)
+    ).reshape(i_seg, bn)
+    xr32 = xr.astype(jnp.float32)
+    rows = []
+    for t in range(bt):
+        cT = (xr32 <= thr_ref[t][:, None]).astype(jnp.int8)
+        sT = jnp.dot(path_ref[t], cT, preferred_element_type=jnp.int32)
+        hit = (sT.astype(jnp.float32) == tgt_ref[t][:, None].astype(
+            jnp.float32)).astype(jnp.bfloat16)
+        vhl = jnp.concatenate(
+            [vhi_ref[t].reshape(1, l_pad), vlo_ref[t].reshape(1, l_pad)], axis=0
+        )
+        hl = jnp.dot(vhl, hit, preferred_element_type=jnp.float32)
+        rows.append(hl[0:1] + hl[1:2])
+    out_ref[:] = jnp.concatenate(rows, axis=0)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n", "T", "S", "bn", "bt", "interpret")
+)
+def _run_segmented(xT, thr, path, tgt, val_hi, val_lo, n, T, S, bn, bt,
+                   interpret):
+    t_pad, i_seg = thr.shape
+    l_pad = tgt.shape[1]
+    n_pad = xT.shape[1]
+    grid = (n_pad // bn, t_pad // bt)
+    out = pl.pallas_call(
+        functools.partial(_kernel_segmented, S=S),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((32, bn), lambda i, j: (0, i)),
+            pl.BlockSpec((bt, i_seg), lambda i, j: (j, 0)),
+            pl.BlockSpec((bt, l_pad, i_seg), lambda i, j: (j, 0, 0)),
+            pl.BlockSpec((bt, l_pad), lambda i, j: (j, 0)),
+            pl.BlockSpec((bt, l_pad), lambda i, j: (j, 0)),
+            pl.BlockSpec((bt, l_pad), lambda i, j: (j, 0)),
+        ],
+        out_specs=pl.BlockSpec((bt, bn), lambda i, j: (j, i)),
+        out_shape=jax.ShapeDtypeStruct((t_pad, n_pad), jnp.float32),
+        interpret=interpret,
+    )(xT, thr, path, tgt, val_hi, val_lo)
+    return out[:T, :n].T
+
+
+_SEG_CACHE = {}
+
+
+def predict_leaves_segmented(gf: GemmForest, x, bn=2048, bt=8, interpret=False):
+    key = (id(gf), bn, bt)
+    if key not in _SEG_CACHE:
+        _SEG_CACHE[key] = _prep_segmented(gf, x, bn, bt)
+    p = _SEG_CACHE[key]
+    n, n_pad, T, t_pad, i_seg, l_pad, S = p["dims"]
+    return _run_segmented(
+        p["xT"], p["thr"], p["path"], p["tgt"], p["val_hi"], p["val_lo"],
+        n=n, T=T, S=S, bn=bn, bt=bt, interpret=interpret,
+    )
+
+
+VARIANTS = {
+    "v0": lambda gf, x: predict_leaves_pallas(gf, x),
+    "v1": lambda gf, x: predict_leaves_transposed(gf, x),
+    "v2": lambda gf, x: predict_leaves_transposed(gf, x, int8=True),
+    "v3": lambda gf, x: predict_leaves_transposed(gf, x, int8=True, batched=True),
+    "v4": lambda gf, x: predict_leaves_transposed(gf, x, int8=True, blockdiag=True),
+    "v5": lambda gf, x: predict_leaves_transposed(gf, x, int8=True, bn=2048),
+    "v6": lambda gf, x: predict_leaves_transposed(
+        gf, x, int8=True, bn=2048, leaf_vpu=True),
+    "v7": lambda gf, x: predict_leaves_transposed(
+        gf, x, int8=True, bn=1024, bt=8),
+    "v8": lambda gf, x: predict_leaves_transposed(
+        gf, x, int8=True, bn=2048, bt=8),
+    "v9": lambda gf, x: predict_leaves_transposed(
+        gf, x, int8=True, bn=4096, bt=8),
+    "v10": lambda gf, x: predict_leaves_transposed(
+        gf, x, int8=True, bn=1024, bt=16),
+    "v11": lambda gf, x: predict_leaves_transposed(
+        gf, x, int8=True, bn=2048, bt=8, leaf_vpu=True),
+    "a_sel": lambda gf, x: predict_leaves_transposed(
+        gf, x, int8=True, bn=4096, bt=8, ablate="sel"),
+    "a_cmp": lambda gf, x: predict_leaves_transposed(
+        gf, x, int8=True, bn=4096, bt=8, ablate="cmp"),
+    "a_main": lambda gf, x: predict_leaves_transposed(
+        gf, x, int8=True, bn=4096, bt=8, ablate="main"),
+    "a_eq": lambda gf, x: predict_leaves_transposed(
+        gf, x, int8=True, bn=4096, bt=8, ablate="eq"),
+    "w1": lambda gf, x: predict_leaves_transposed(
+        gf, x, int8=True, bn=4096, bt=8, fv_bf16=True),
+    "w2": lambda gf, x: predict_leaves_transposed(
+        gf, x, bn=4096, bt=8, fv_bf16=True, main_bf16=True),
+    "w3": lambda gf, x: predict_leaves_transposed(
+        gf, x, bn=4096, bt=8, fv_bf16=True, main_bf16=True, relu_hit=True),
+    "w4": lambda gf, x: predict_leaves_transposed(
+        gf, x, bn=8192, bt=8, fv_bf16=True, main_bf16=True, relu_hit=True),
+    "w5": lambda gf, x: predict_leaves_transposed(
+        gf, x, bn=4096, bt=16, fv_bf16=True, main_bf16=True, relu_hit=True),
+    "w6": lambda gf, x: predict_leaves_transposed(
+        gf, x, int8=True, bn=1024, bt=8, fv_bf16=True, bigsel=True),
+    "w7": lambda gf, x: predict_leaves_transposed(
+        gf, x, int8=True, bn=2048, bt=8, fv_bf16=True, bigsel=True),
+    "w8": lambda gf, x: predict_leaves_transposed(
+        gf, x, int8=True, bn=2048, bt=4, fv_bf16=True, bigsel=True),
+    "w9": lambda gf, x: predict_leaves_transposed(
+        gf, x, int8=True, bn=1024, bt=16, fv_bf16=True, bigsel=True),
+    "w10": lambda gf, x: predict_leaves_transposed(
+        gf, x, bn=2048, bt=8, fv_bf16=True, bigsel=True, main_bf16=True),
+    "w12": lambda gf, x: predict_leaves_transposed(
+        gf, x, int8=True, bn=2048, bt=8, fv_bf16=True, bigsel=True,
+        tree_outer=True),
+    "w13": lambda gf, x: predict_leaves_transposed(
+        gf, x, int8=True, bn=4096, bt=8, fv_bf16=True, tree_outer=True),
+    "w14": lambda gf, x: predict_leaves_transposed(
+        gf, x, int8=True, bn=2048, bt=8, tree_outer=True),
+    "r1": lambda gf, x: predict_leaves_segmented(gf, x, bn=2048, bt=8),
+    "r2": lambda gf, x: predict_leaves_segmented(gf, x, bn=4096, bt=8),
+    "r3": lambda gf, x: predict_leaves_segmented(gf, x, bn=1024, bt=8),
+    "wf": lambda gf, x: predict_leaves_transposed(
+        gf, x, int8=True, bn=2048, bt=8, fv_bf16=True, bigsel=True,
+        leaf_f32=True),
+}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--pool", type=int, default=284_807)
+    ap.add_argument("--features", type=int, default=30)
+    ap.add_argument("--trees", type=int, default=100)
+    ap.add_argument("--depth", type=int, default=8)
+    ap.add_argument("--train-rows", type=int, default=5000)
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--variants", default="v0,v1,v2,v3,v4")
+    ap.add_argument("--bn", type=int, default=_BN)
+    ap.add_argument("--bt", type=int, default=_BT)
+    args = ap.parse_args()
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(args.pool, args.features)).astype(np.float32))
+    tx = rng.normal(size=(args.train_rows, args.features)).astype(np.float32)
+    ty = (tx[:, 0] + 0.3 * tx[:, 1] > 0).astype(np.int32)
+    gf = forest_eval.for_kernel(
+        fit_forest_classifier(tx, ty, ForestConfig(n_trees=args.trees, max_depth=args.depth)),
+        "gemm",
+    )
+    T, I = gf.feat_ids.shape
+    L = gf.value.shape[1]
+    flops_pp = 2 * T * I * L + 2 * T * L
+
+    # Interleaved (round-robin) timing: the tunnel chip's throughput drifts
+    # +-30% across seconds, so back-to-back per-variant loops confound drift
+    # with the variant. One measurement per variant per round cancels it.
+    names, agree, times = [], {}, {}
+    ref = None
+    for name in args.variants.split(","):
+        fn = VARIANTS[name]
+        try:
+            out = jax.block_until_ready(fn(gf, x))  # compile + warm
+        except Exception as e:
+            print(f"{name}: FAILED {type(e).__name__}: {str(e)[:200]}")
+            continue
+        if ref is None:
+            ref = out
+        agree[name] = float(jnp.mean((out > 0.5) == (ref > 0.5)))
+        names.append(name)
+        times[name] = []
+    for _ in range(args.iters):
+        for name in names:
+            t0 = time.perf_counter()
+            jax.block_until_ready(VARIANTS[name](gf, x))
+            times[name].append(time.perf_counter() - t0)
+    for name in names:
+        sec = float(np.median(times[name]))
+        sps = args.pool / sec
+        mfu = sps * flops_pp / 197e12
+        print(
+            f"{name}: {sec*1e3:8.2f} ms  {sps/1e6:6.3f}M scores/s  "
+            f"mfu={mfu:6.2%}  vote_agree={agree[name]:.6f}"
+        )
+
+
+if __name__ == "__main__":
+    main()

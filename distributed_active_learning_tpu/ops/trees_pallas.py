@@ -3,33 +3,67 @@
 The XLA GEMM kernel (``ops/trees_gemm.py``) lowers to two batched matmuls with
 elementwise stages between them; its ``[chunk, T, I]`` compare and
 ``[chunk, T, L]`` hit tensors round-trip through HBM, which caps it at ~5% MFU
-(BENCH_r02/r03: ~10 bf16 TFLOP/s on a v5e whose peak is 197) — the classic
-bandwidth-bound fusion gap. This kernel performs the whole chain
+— the classic bandwidth-bound fusion gap. This kernel performs the whole chain
 
     select features -> compare thresholds -> path GEMM -> leaf-hit test ->
-    leaf-value contraction
+    leaf-value gather
 
 for a (row-block x tree-block) tile entirely in VMEM, so HBM traffic drops to
-the inputs (x once per tree-block sweep, path matrices once per row-block) and
-the [BN, I]/[BN, L] intermediates never leave the chip. Measured on the
-BASELINE workload (284,807x30 pool, 100 trees, depth 8, one v5e chip):
-2.07M scores/s at 13.8% MFU vs 0.82M at 5.4% for the two-GEMM form — the
-fusion recovers the 2.5x the bandwidth cap was costing. Remaining headroom is
-the one-hot selection matmul (d=30 pads to 128 lanes: ~4x its useful FLOPs)
-and the vector-unit compare/equality stages between the MXU ops.
+the inputs and the per-tree intermediates never leave the chip.
 
-Feature selection is itself expressed as an MXU matmul against a one-hot
-``[d, T*I]`` selector (gathers are the one primitive the MXU cannot help
-with), which costs ``2*BN*d_pad*I`` — ~12-50% of the main ``2*BN*I*L`` GEMM
-depending on feature-count padding.
+r4 redesign (transposed layout), measured on the BASELINE workload
+(284,807x30 pool, 100 trees, depth 8, one v5e chip; interleaved medians —
+see the instrument note below):
 
-Numerics: features are compared in bfloat16 (they ride the MXU), so a vote can
-differ from the exact f32 kernels only when a feature value sits within bf16
-rounding distance (~0.4%) of a threshold. For device-fit forests
-(``ops/trees_train.py``) thresholds are quantile-bin edges and inputs can be
-integer bin codes — exact in bf16 — so there the kernel is bit-identical.
-The reference's own MLlib trainer bins features to 32 levels
-(``uncertainty_sampling.py:74``), far coarser than bf16 resolution.
+- ``x`` streams transposed (``[d_pad, n]``), every stage is tree-major, and
+  the output tile ``[bt, BN]`` needs no in-kernel transpose.
+- The main path GEMM runs in **int8** (compare bits in {0,1}, path entries
+  in {-1,0,+1}: exact, and 2x the bf16 MXU rate on v5e).
+- The per-tree leaf matvecs are f32 ``[1, L] x [L, BN]`` rows — full output
+  lanes (the r3 kernel's ``[BN, L] x [L]`` orientation used 1 of 128 output
+  lanes and cost as much MXU time as the main GEMM).
+- One selection matmul per tile covers the whole tree block; its f32
+  accumulator is downcast to bf16 before spilling (compare runs in f32 from
+  the bf16 values, so semantics are unchanged).
+
+Result: 2.27M scores/s at 15.1% MFU vs 1.56M / 10.4% for the r3 kernel in
+the same interleaved run (~1.45x). The r4 target of ~28% MFU was **not**
+reached; the measured evidence says the remaining gap is not MXU FLOPs:
+
+- Roofline: the selection matmul is pinned at ``2*T*I*128*n`` FLOPs (the MXU
+  cannot contract over fewer than 128 lanes), ~50% of the main GEMM — yet a
+  feature-segmented variant that removes the selection matmul *entirely*
+  (compare operand built by a VPU broadcast-reshape against per-feature node
+  segments; ``benches/pallas_variants.py`` r1-r3) measures the SAME
+  throughput as this kernel. The bound is therefore the VPU compare/equality
+  stages and Mosaic's serialization of the per-tree dependency chains, not
+  matmul throughput; int8 vs bf16 main GEMMs, tiling (BN 512-8192, BT 4-16),
+  grid order, and batched-vs-looped matmuls all move the result <10%.
+- Instrument note: the tunnel-attached chip drifts +-30% across minutes and
+  small ops under-report (async completion), so all kernel comparisons in
+  ``benches/pallas_variants.py`` interleave variants round-robin and only
+  steady-state full-pool timings are trusted.
+
+Feature selection is expressed as an MXU matmul against a one-hot
+``[T*I, d_pad]`` selector (gathers are the one primitive the MXU cannot
+help with); d pads to 128 lanes, so at d=30 the selection matmul spends ~4x
+its useful FLOPs — structural to the formulation, see the roofline note.
+
+Numerics: features are compared in bfloat16 (they ride the MXU), thresholds
+stay f32, leaf payloads are gathered in full f32 (the hit one-hot is exact) —
+identical contract to the r3 kernel. A vote can differ from the exact f32
+kernels only when a feature value sits within bf16 rounding distance (~0.4%)
+of a threshold. For device-fit forests (``ops/trees_train.py``) thresholds
+are quantile-bin edges and inputs can be integer bin codes — exact in bf16 —
+so there the kernel is bit-identical. The reference's own MLlib trainer bins
+features to 32 levels (``uncertainty_sampling.py:74``), far coarser than bf16
+resolution.
+
+Shape limits: tree blocks are 8 trees, so the path tile is
+``8 * 2^depth * 2^depth`` int8 bytes; past depth 8 (or d_pad > 512) the
+VMEM budget is blown and evaluation falls back to the exact GEMM kernel
+(``predict_leaves_gemm``) — still one fused XLA program, just HBM-resident
+intermediates.
 """
 
 from __future__ import annotations
@@ -42,7 +76,10 @@ import numpy as np
 from flax import struct
 from jax.experimental import pallas as pl
 
-from distributed_active_learning_tpu.ops.trees_gemm import GemmForest
+from distributed_active_learning_tpu.ops.trees_gemm import (
+    GemmForest,
+    predict_leaves_gemm,
+)
 
 
 @struct.dataclass
@@ -60,40 +97,13 @@ class PallasForest:
     def n_trees(self) -> int:
         return self.gf.n_trees
 
-# Row-block and tree-block tile sizes. A v5e sweep put 512x32/2048x8 ~5%
-# ahead of 512x16 standalone, but those tilings exceed the 16 MB scoped-VMEM
-# limit once the kernel is fused into the full acquisition program, so the
-# defaults stay at the proven 512x16 (2.07M scores/s, 13.8% MFU on the
-# 284,807x30/100-tree workload). The effective tree block shrinks with depth
-# so the [BT, I, L] path tile stays bounded (depth 10 ⇒ 2 MB/tree ⇒ BT=1).
-_BN = 512
-_BT = 16
-_PATH_TILE_BYTES = 2 << 20
 
-
-def _tree_block(t_cnt: int, i_pad: int, l_pad: int) -> int:
-    budget = max(_PATH_TILE_BYTES // (i_pad * l_pad * 2), 1)
-    return max(min(_BT, t_cnt, budget), 1)
-
-
-def _kernel(x_ref, sel_ref, thr_ref, path_ref, tgt_ref, val_ref, out_ref):
-    bn = x_ref.shape[0]
-    bt, i_dim = thr_ref.shape
-    # One selection matmul covers every tree in the block: [BN, dp] x
-    # [dp, BT*I] -> feature values routed to each internal-node slot.
-    fv = jnp.dot(x_ref[:], sel_ref[:], preferred_element_type=jnp.float32)
-    c = (fv.reshape(bn, bt, i_dim) <= thr_ref[:][None, :, :]).astype(jnp.bfloat16)
-    preds = []
-    for t in range(bt):
-        # Ancestor-agreement counts: the main MXU GEMM, per tree.
-        s = jnp.dot(c[:, t, :], path_ref[t], preferred_element_type=jnp.float32)
-        hit = (s == tgt_ref[t][None, :]).astype(jnp.float32)  # exactly one 1/row
-        # Leaf payload selection: [BN, L] x [L] matvec (f32: hit is one-hot,
-        # so this is an exact gather-by-matmul of the leaf value).
-        preds.append(jnp.dot(hit, val_ref[t], preferred_element_type=jnp.float32))
-    # Tree-major output: the [bt, BN] tile is lane-aligned (BN % 128 == 0)
-    # where [BN, bt] would violate the TPU's last-dim-128 tiling rule.
-    out_ref[:] = jnp.stack(preds, axis=0)
+# Tree block (out-tile sublane count: 8 is the f32 minimum) and the VMEM
+# budget gates. A v5e sweep (benches/pallas_variants.py) put BN=2048/BT=8
+# ahead of the r3 512x16 tiling; small pools drop to BN=512 to bound padding.
+_BT = 8
+_MAX_I_PAD = 256   # depth 8: past this the [BT, L, I] path tile blows VMEM
+_MAX_D_PAD = 512   # x tile [d_pad, BN] budget
 
 
 def _pad_to(a: jnp.ndarray, axis: int, mult: int, value=0) -> jnp.ndarray:
@@ -105,60 +115,88 @@ def _pad_to(a: jnp.ndarray, axis: int, mult: int, value=0) -> jnp.ndarray:
     return jnp.pad(a, widths, constant_values=value)
 
 
+def _kernel(xT_ref, selT_ref, thr_ref, pathT_ref, tgt_ref, val_ref, out_ref):
+    bt, i_pad = thr_ref.shape
+    l_pad = pathT_ref.shape[1]
+    # One selection matmul covers the tree block: [BT*I, d_pad] x [d_pad, BN]
+    # routes each node slot's feature value to it. The f32 accumulator is
+    # downcast before it spills (values are bf16-exact copies of x).
+    fv_all = jnp.dot(
+        selT_ref[:], xT_ref[:], preferred_element_type=jnp.float32
+    ).astype(jnp.bfloat16)
+    rows = []
+    for t in range(bt):
+        fvT = fv_all[t * i_pad:(t + 1) * i_pad]
+        # bf16 [N,1]-broadcast compares crash Mosaic; compare in f32.
+        cT = (fvT.astype(jnp.float32) <= thr_ref[t][:, None]).astype(jnp.int8)
+        # Ancestor-agreement counts: int8 x int8 -> int32, exact and 2x the
+        # bf16 MXU rate.
+        sT = jnp.dot(pathT_ref[t], cT, preferred_element_type=jnp.int32)
+        # Exactly one hit per column (the reached leaf).
+        hit = (sT.astype(jnp.float32) == tgt_ref[t][:, None]).astype(
+            jnp.float32)
+        # Leaf gather as a full-lane f32 matvec row: exact payload.
+        rows.append(jnp.dot(val_ref[t].reshape(1, l_pad), hit,
+                            preferred_element_type=jnp.float32))
+    out_ref[:] = jnp.concatenate(rows, axis=0)
+
+
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def predict_leaves_pallas(
     gf: GemmForest, x: jnp.ndarray, interpret: bool = False
 ) -> jnp.ndarray:
-    """Per-tree leaf values ``[n, T]`` via the fused VMEM-resident kernel."""
+    """Per-tree leaf values ``[n, T]`` via the fused VMEM-resident kernel.
+
+    Falls back to the exact GEMM kernel when the forest/feature shapes exceed
+    the kernel's VMEM tiling budget (depth > 8 or d_pad > 512).
+    """
     n, d = x.shape
     T, I = gf.feat_ids.shape
     L = gf.value.shape[1]
 
-    # Lane-align the tile dims (last dim 128 for f32/bf16 tiling).
     i_pad = max(-(-I // 128) * 128, 128)
     l_pad = max(-(-L // 128) * 128, 128)
     d_pad = max(-(-d // 128) * 128, 128)
+    if i_pad > _MAX_I_PAD or d_pad > _MAX_D_PAD:
+        return predict_leaves_gemm(gf, x)
+    bn = 2048 if n >= 1536 else 512
 
-    # One-hot feature selector [d_pad, T*i_pad] (tree-major columns).
     feat = _pad_to(gf.feat_ids, 1, i_pad)  # padded slots select feature 0...
-    thr = _pad_to(gf.thresholds, 1, i_pad, value=-np.inf)  # ...and compare False
-    sel = jax.nn.one_hot(feat.reshape(-1), d_pad, dtype=jnp.bfloat16)  # [T*ip, dp]
-
-    path = _pad_to(_pad_to(gf.path, 1, i_pad), 2, l_pad).astype(jnp.bfloat16)
-    # Padded leaves carry an unreachable target, padded internal slots a 0 path
-    # row — they add 0 to s and never hit.
+    thr = _pad_to(gf.thresholds, 1, i_pad, value=-np.inf)  # ...compare False
+    path = _pad_to(_pad_to(gf.path, 1, i_pad), 2, l_pad)
+    # Padded leaves carry an unreachable target, padded internal slots a 0
+    # path row — they add 0 to s and never hit.
     tgt = _pad_to(gf.target, 1, l_pad, value=1.0e6)
     val = _pad_to(gf.value, 1, l_pad)
 
-    # Pad rows/trees to tile multiples.
-    xp = _pad_to(x.astype(jnp.bfloat16), 1, d_pad)
-    xp = _pad_to(xp, 0, _BN)
-    n_pad, t_cnt = xp.shape[0], thr.shape[0]
-    bt = _tree_block(t_cnt, i_pad, l_pad)
-    sel = _pad_to(sel.reshape(T, i_pad, d_pad), 0, bt)
-    thr = _pad_to(thr, 0, bt, value=-np.inf)
-    path = _pad_to(path, 0, bt)
-    tgt = _pad_to(tgt, 0, bt, value=1.0e6)
-    val = _pad_to(val, 0, bt)
+    feat = _pad_to(feat, 0, _BT)
+    thr = _pad_to(thr, 0, _BT, value=-np.inf)
+    path = _pad_to(path, 0, _BT)
+    tgt = _pad_to(tgt, 0, _BT, value=1.0e6)
+    val = _pad_to(val, 0, _BT)
     t_pad = thr.shape[0]
-    sel = sel.transpose(2, 0, 1).reshape(d_pad, t_pad * i_pad)
 
-    grid = (n_pad // _BN, t_pad // bt)
+    selT = jax.nn.one_hot(feat.reshape(-1), d_pad, dtype=jnp.bfloat16)
+    pathT = jnp.swapaxes(path, 1, 2).astype(jnp.int8)
+    xT = _pad_to(_pad_to(x.astype(jnp.bfloat16), 1, d_pad), 0, bn).T
+    n_pad = xT.shape[1]
+
+    grid = (n_pad // bn, t_pad // _BT)
     out = pl.pallas_call(
         _kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((_BN, d_pad), lambda i, j: (i, 0)),
-            pl.BlockSpec((d_pad, bt * i_pad), lambda i, j: (0, j)),
-            pl.BlockSpec((bt, i_pad), lambda i, j: (j, 0)),
-            pl.BlockSpec((bt, i_pad, l_pad), lambda i, j: (j, 0, 0)),
-            pl.BlockSpec((bt, l_pad), lambda i, j: (j, 0)),
-            pl.BlockSpec((bt, l_pad), lambda i, j: (j, 0)),
+            pl.BlockSpec((d_pad, bn), lambda i, j: (0, i)),
+            pl.BlockSpec((_BT * i_pad, d_pad), lambda i, j: (j, 0)),
+            pl.BlockSpec((_BT, i_pad), lambda i, j: (j, 0)),
+            pl.BlockSpec((_BT, l_pad, i_pad), lambda i, j: (j, 0, 0)),
+            pl.BlockSpec((_BT, l_pad), lambda i, j: (j, 0)),
+            pl.BlockSpec((_BT, l_pad), lambda i, j: (j, 0)),
         ],
-        out_specs=pl.BlockSpec((bt, _BN), lambda i, j: (j, i)),
+        out_specs=pl.BlockSpec((_BT, bn), lambda i, j: (j, i)),
         out_shape=jax.ShapeDtypeStruct((t_pad, n_pad), jnp.float32),
         interpret=interpret,
-    )(xp, sel, thr, path, tgt, val)
+    )(xT, selT, thr, pathT, tgt, val)
     return out[:T, :n].T
 
 

@@ -62,14 +62,18 @@ def maybe_initialize() -> bool:
     service nothing connects to.
     """
     nproc = os.environ.get(_ENV_NPROC)
-    if os.environ.get(_ENV_COORD) is not None and nproc is not None and int(nproc) > 1:
+    if nproc is not None and int(nproc) <= 1:
+        # Explicit single-process override: lets a pod worker run standalone
+        # (debug runs, --list) without blocking at the distributed barrier.
+        return False
+    if os.environ.get(_ENV_COORD) is not None and nproc is not None:
         initialize()
         return True
     # Cloud TPU pod: worker hostnames are provisioned into the env; >1 worker
     # means multi-host, and initialize() auto-detects coordinator/count/id.
     workers = os.environ.get("TPU_WORKER_HOSTNAMES", "")
     if len(workers.split(",")) > 1:
-        jax.distributed.initialize()
+        initialize()
         return True
     return False
 

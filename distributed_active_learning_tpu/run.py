@@ -126,14 +126,6 @@ def main(argv=None) -> int:
     ap = build_parser()
     args = ap.parse_args(argv)
 
-    # Join a multi-host job before any jax device use, iff one is configured
-    # (JAX_COORDINATOR_ADDRESS/...); otherwise a pod launch would run each
-    # host as an independent process-0 job and every host would write
-    # checkpoints/results (the process-0-only gates would never engage).
-    from distributed_active_learning_tpu.parallel import multihost
-
-    multihost.maybe_initialize()
-
     if args.list:
         from distributed_active_learning_tpu.data import available_datasets
         from distributed_active_learning_tpu.runtime.neural_loop import (
@@ -145,6 +137,17 @@ def main(argv=None) -> int:
         print("strategies:", ", ".join(available_strategies()))
         print("deep strategies:", ", ".join(available_deep_strategies()))
         return 0
+
+    # Join a multi-host job before any jax device use, iff one is configured
+    # (explicit coordinator env or Cloud TPU pod metadata); otherwise a pod
+    # launch would run each host as an independent process-0 job and every
+    # host would write checkpoints/results (the process-0-only gates would
+    # never engage). Placed after --list so metadata queries on one pod
+    # worker never block at the distributed barrier; JAX_NUM_PROCESSES=1
+    # opts a worker out explicitly.
+    from distributed_active_learning_tpu.parallel import multihost
+
+    multihost.maybe_initialize()
 
     from distributed_active_learning_tpu.runtime.debugger import Debugger
     from distributed_active_learning_tpu.runtime.loop import run_experiment

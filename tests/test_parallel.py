@@ -204,9 +204,35 @@ def test_multihost_helpers_single_host(monkeypatch):
 
     monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
     monkeypatch.delenv("JAX_NUM_PROCESSES", raising=False)
+    monkeypatch.delenv("TPU_WORKER_HOSTNAMES", raising=False)
     assert multihost.maybe_initialize() is False
     monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "localhost:1234")
     monkeypatch.setenv("JAX_NUM_PROCESSES", "1")
     assert multihost.maybe_initialize() is False  # one process: nothing to join
     assert multihost.is_primary()
     assert multihost.process_count() == 1
+
+
+def test_multihost_pod_detection(monkeypatch):
+    """TPU pod metadata (>1 worker hostname) triggers auto-detected init;
+    JAX_NUM_PROCESSES=1 opts a worker out so standalone debug runs on one
+    pod host never block at the distributed barrier."""
+    from distributed_active_learning_tpu.parallel import multihost
+
+    calls = []
+    monkeypatch.setattr(
+        multihost.jax.distributed, "initialize",
+        lambda *a, **k: calls.append((a, k)),
+    )
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    monkeypatch.delenv("JAX_NUM_PROCESSES", raising=False)
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "w0,w1,w2,w3")
+    assert multihost.maybe_initialize() is True
+    assert len(calls) == 1
+    monkeypatch.setenv("JAX_NUM_PROCESSES", "1")  # explicit standalone opt-out
+    assert multihost.maybe_initialize() is False
+    assert len(calls) == 1
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "w0")  # single worker: no-op
+    monkeypatch.delenv("JAX_NUM_PROCESSES", raising=False)
+    assert multihost.maybe_initialize() is False
+    assert len(calls) == 1

@@ -99,6 +99,20 @@ def test_pallas_kernel_runs_experiment_end_to_end():
     )
 
 
+def test_pallas_depth9_uses_gemm_fallback_exactly():
+    """Depth 9-10 stays path-matrix-representable but exceeds the fused
+    kernel's VMEM tiling budget; predict_leaves_pallas must hand those to the
+    exact GEMM kernel bit-for-bit."""
+    packed, pool = _grid_forest(trees_=4, depth=4)
+    gf = trees_gemm.gemm_forest_from_packed(packed)
+    # Re-pad the same forest into a depth-9-sized path matrix (I=511): the
+    # values are unchanged, only the shapes cross the kernel's budget.
+    wide = trees_gemm.gemm_forest_from_packed(packed, n_internal=511, n_leaves=512)
+    ref = np.asarray(trees_gemm.predict_leaves_gemm(gf, pool))
+    got = np.asarray(trees_pallas.predict_leaves_pallas(wide, pool, interpret=True))
+    np.testing.assert_allclose(got, ref, atol=0)
+
+
 def test_pallas_deep_forest_falls_back_like_gemm():
     """Past the path-matrix depth cap the pallas spelling degrades to the
     gather representation, same as kernel='gemm'."""
