@@ -34,33 +34,37 @@ from distributed_active_learning_tpu.models.forest import fit_forest_regressor
 from distributed_active_learning_tpu.ops.trees import PackedForest
 
 
+def _tree_votes(model: RandomForestClassifier, x: np.ndarray) -> np.ndarray:
+    """Per-tree positive votes ``[T, n]`` (host twin of the device kernel)."""
+    pos_col = list(model.classes_).index(1) if 1 in model.classes_ else None
+    if pos_col is None:
+        return np.zeros((len(model.estimators_), x.shape[0]))
+    return np.stack(
+        [est.predict_proba(x)[:, pos_col] > 0.5 for est in model.estimators_]
+    ).astype(np.float64)
+
+
 def _lal_point_features(
     model: RandomForestClassifier,
     candidate: np.ndarray,
     labeled_y: np.ndarray,
     pool_x: np.ndarray,
+    f6: Optional[float] = None,
 ) -> np.ndarray:
     """The 5 LAL features for one candidate point (host/numpy twin of
     ``strategies.lal.lal_features``; order f_1, f_2, f_3, f_6, f_8 per
-    ``active_learner.py:280-296``)."""
-    pos_col = list(model.classes_).index(1) if 1 in model.classes_ else None
-
-    def tree_votes(x):
-        if pos_col is None:
-            return np.zeros((len(model.estimators_), x.shape[0]))
-        return np.stack(
-            [est.predict_proba(x)[:, pos_col] > 0.5 for est in model.estimators_]
-        ).astype(np.float64)
-
-    votes_cand = tree_votes(candidate[None, :])[:, 0]
+    ``active_learner.py:280-296``). ``f6`` (the pool-level mean vote SD) is
+    candidate-independent — callers scoring many candidates of one pool pass
+    it precomputed."""
+    votes_cand = _tree_votes(model, candidate[None, :])[:, 0]
     n_trees = len(model.estimators_)
     f1 = votes_cand.mean()
     p = votes_cand.sum() / n_trees
     f2 = np.sqrt(p * (1 - p))
     f3 = float((labeled_y == 1).mean()) if len(labeled_y) else 0.0
-    votes_pool = tree_votes(pool_x)
-    p_pool = votes_pool.mean(axis=0)
-    f6 = float(np.sqrt(p_pool * (1 - p_pool)).mean())
+    if f6 is None:
+        p_pool = _tree_votes(model, pool_x).mean(axis=0)
+        f6 = float(np.sqrt(p_pool * (1 - p_pool)).mean())
     f8 = float(len(labeled_y))
     return np.array([f1, f2, f3, f6, f8], dtype=np.float32)
 
@@ -99,8 +103,10 @@ def generate_lal_dataset(
         model.fit(tx[lab_idx], ty[lab_idx])
         err0 = 1.0 - model.score(ex, ey)
 
+        p_pool = _tree_votes(model, tx[unlab_idx]).mean(axis=0)
+        f6 = float(np.sqrt(p_pool * (1 - p_pool)).mean())
         for c in rng.choice(unlab_idx, size=min(candidates_per_experiment, len(unlab_idx)), replace=False):
-            fv = _lal_point_features(model, tx[c], ty[lab_idx], tx[unlab_idx])
+            fv = _lal_point_features(model, tx[c], ty[lab_idx], tx[unlab_idx], f6=f6)
             aug = np.concatenate([lab_idx, [c]])
             m2 = RandomForestClassifier(
                 n_estimators=n_trees, max_depth=max_depth, random_state=int(rng.integers(1 << 30))
@@ -178,3 +184,44 @@ def load_or_train_lal_regressor(options: Mapping) -> PackedForest:
         packed = _train()
     _CACHE[key] = packed
     return packed
+
+
+def _main(argv=None) -> int:
+    """Generate a reference-format LAL training dataset shard.
+
+    The reference's ``lal_randomtree_simulatedunbalanced_big.txt`` was
+    pre-synthesized offline at thousands of rows; this is its generator
+    (one shard per process — experiments are independent, so reference-scale
+    datasets are produced by running several seeds in parallel and
+    concatenating, e.g.::
+
+        for s in 0 1 2 3 4 5 6 7; do
+          python -m distributed_active_learning_tpu.models.lal_training \
+              --seed $s --experiments 90 --out /tmp/lal_shard_$s.txt &
+        done; wait; cat /tmp/lal_shard_*.txt > lal_simulatedunbalanced_big.txt
+
+    Output rows: 5 whitespace-separated features then the error-reduction
+    target (the format ``lal_data_path`` loads).
+    """
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="lal_training")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--experiments", type=int, default=90)
+    ap.add_argument("--candidates", type=int, default=8)
+    ap.add_argument("--pool-size", type=int, default=200)
+    ap.add_argument("--out", required=True)
+    args = ap.parse_args(argv)
+    feats, targets = generate_lal_dataset(
+        seed=args.seed,
+        n_experiments=args.experiments,
+        candidates_per_experiment=args.candidates,
+        pool_size=args.pool_size,
+    )
+    np.savetxt(args.out, np.column_stack([feats, targets]), fmt="%.8g")
+    print(f"{feats.shape[0]} rows -> {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(_main())

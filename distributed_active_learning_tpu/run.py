@@ -88,6 +88,12 @@ def build_parser() -> argparse.ArgumentParser:
     # config count stays under --batchbald-max-configs, and the greedy batch is
     # drawn from the top --candidate-pool unlabeled points by marginal BALD.
     ap.add_argument("--batchbald-max-configs", type=int, default=4096)
+    ap.add_argument(
+        "--batchbald-samples", type=int, default=256,
+        help="MC configurations carried past the exact-joint cap (picks "
+        "beyond log_C(max-configs) stay joint-aware via Kirsch et al.'s "
+        "sampled estimator)",
+    )
     ap.add_argument("--candidate-pool", type=int, default=512)
     ap.add_argument(
         "--coreset-space", choices=["input", "embedding"], default="input",
@@ -303,6 +309,7 @@ def _run_neural(args, dbg):
         seed=args.seed,
         batchbald_max_configs=args.batchbald_max_configs,
         batchbald_candidate_pool=args.candidate_pool,
+        batchbald_mc_samples=args.batchbald_samples,
         beta=args.beta,
         coreset_space=args.coreset_space,
         checkpoint_dir=args.checkpoint_dir,

@@ -67,6 +67,9 @@ class NeuralExperimentConfig:
     # Greedy BatchBALD candidates (top-k unlabeled by marginal BALD); larger
     # pools are truncated to this many — logged when it happens.
     batchbald_candidate_pool: int = 512
+    # MC configurations carried past the exact-joint cap (Kirsch et al.'s
+    # sampled estimator; picks beyond log_C(max_configs) stay joint-aware).
+    batchbald_mc_samples: int = 256
     # Information-density exponent (deep.density: entropy x mass**beta, the
     # neural form of density_weighting.py's beta at :33).
     beta: float = 1.0
@@ -100,7 +103,11 @@ def neural_fingerprint(
         "n_start": cfg.n_start,
         "seed": cfg.seed,
         "retrain_from_scratch": cfg.retrain_from_scratch,
-        "batchbald": (cfg.batchbald_max_configs, cfg.batchbald_candidate_pool),
+        "batchbald": (
+            cfg.batchbald_max_configs,
+            cfg.batchbald_candidate_pool,
+            cfg.batchbald_mc_samples,
+        ),
         "beta": cfg.beta,
         "coreset_space": cfg.coreset_space,
         # flax modules are dataclasses: repr() pins the architecture + sizes.
@@ -309,6 +316,8 @@ def run_neural_experiment(
                     cfg.window_size,
                     cfg.batchbald_max_configs,
                     cfg.batchbald_candidate_pool,
+                    cfg.batchbald_mc_samples,
+                    key=k_rand,
                 )
             else:
                 probs = learner.predict_proba_samples(net_state, pool_x, k_mc)

@@ -9,7 +9,9 @@ are capability extensions following the standard definitions:
 - BatchBALD           I(y_1..y_k; w) maximized greedily with an exact joint
                       over sampled posteriors (Kirsch et al. 2019), tracked as
                       a [S, configs] tensor while configs <= max_configs, then
-                      falling back to BALD for any remaining picks
+                      MC-sampled (m configurations drawn from the exact joint,
+                      importance-weighted joint entropies) so every later pick
+                      stays joint-aware
 - mean-std            mean over classes of std over posterior samples
 - variation ratios    1 - max_c E_s p
 - coreset             k-Center-Greedy batch diversity (Sener & Savarese 2018)
@@ -83,33 +85,55 @@ def _joint_entropy_candidates(joint: jnp.ndarray, probs: jnp.ndarray) -> jnp.nda
     return -jnp.sum(q * jnp.log(q + _EPS), axis=(1, 2))
 
 
-@functools.partial(jax.jit, static_argnums=(2, 3, 4))
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "max_configs", "candidate_pool", "mc_samples"),
+)
 def batchbald_select(
     probs_samples: jnp.ndarray,
     unlabeled_mask: jnp.ndarray,
     k: int,
     max_configs: int = 4096,
     candidate_pool: int = 512,
+    mc_samples: int = 256,
+    key: jax.Array | None = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Greedy BatchBALD batch of ``k`` points — one compiled selection.
 
     The greedy loop is *unrolled under jit*: the joint's config count at pick
     ``t`` is the static ``C^t``, so every iteration has static shapes and the
-    exact→marginal-BALD fallback branch (``C^t > max_configs``) resolves at
-    trace time. One XLA launch replaces k host-driven rounds of device ops.
+    exact→MC switch (``C^t > max_configs``) resolves at trace time. One XLA
+    launch replaces k host-driven rounds of device ops.
+
+    Joint-entropy tracking has two regimes (Kirsch et al. 2019):
+
+    - **exact** while the config count ``C^chosen`` stays within
+      ``max_configs``: the joint rides as a ``[S, configs]`` tensor (binary
+      problems: window 12 at the default cap).
+    - **MC-sampled** beyond it: ``mc_samples`` batch-label configurations
+      ``ŷ^m`` are drawn from the exact joint at the switch point, and the
+      estimator  ``H ≈ -(1/M) Σ_m Σ_c P(ŷ^m, y_i=c)/P(ŷ^m) · log P(ŷ^m, y_i=c)``
+      keeps every later pick joint-aware (the r3 kernel fell back to marginal
+      BALD here, so 4-class window-50 batches were ~88% plain BALD). The
+      per-sample weights ride normalized (``mean_s W = 1``) with a log-space
+      offset so f32 never underflows at deep windows, and each pick extends
+      every sampled config with a class drawn from its conditional.
 
     Memory plan: the greedy joint is evaluated only over the top
     ``candidate_pool`` unlabeled points by marginal BALD (standard practice —
     BatchBALD's own experiments subsample candidates), bounding the per-pick
-    intermediate to ``candidate_pool * max_configs`` floats instead of
-    ``n_pool * max_configs``. The joint over MC posterior samples is exact
-    while the config count C^chosen stays within ``max_configs`` (binary
-    problems: window 12 at the default cap); further picks use marginal BALD —
-    documented fallback, no silent wrong answers.
+    intermediate to ``candidate_pool * max(max_configs, mc_samples * C)``
+    floats instead of pool-sized ones.
+
+    ``key`` seeds the MC config draws (``None``: fixed seed — deterministic
+    selection, fine for the estimator since the randomness is over
+    configurations, not data).
 
     Returns ``(picked_idx [k], scores_at_pick [k])`` as pool-level indices.
     """
     S, n, C = probs_samples.shape
+    if key is None:
+        key = jax.random.key(0)
     bald = bald_score(probs_samples)
 
     # Candidate restriction by marginal BALD (labeled points excluded).
@@ -119,10 +143,11 @@ def batchbald_select(
     _, cand = jax.lax.top_k(jnp.where(unlabeled_mask, bald, -jnp.inf), m)  # [m]
     cand_probs = probs_samples[:, cand, :]  # [S, m, C]
     cond_ent = expected_conditional_entropy(cand_probs)  # [m]
-    cand_bald = bald[cand]
     cand_valid = unlabeled_mask[cand]  # top_k tail may hit labeled -inf entries
 
     joint = jnp.ones((S, 1), dtype=probs_samples.dtype)
+    W = None          # [S, M] normalized sampled-config weights (MC regime)
+    offs = None       # [M] log P(ŷ^m) offsets
     chosen_mask = ~cand_valid  # within-candidate excluded set
     picked = []
     scores = []
@@ -130,22 +155,46 @@ def batchbald_select(
     exact = True
 
     for _ in range(k):
-        if exact and joint.shape[1] * C <= max_configs:
-            h_joint = _joint_entropy_candidates(joint, cand_probs)  # [m]
-            score = h_joint - (sum_cond + cond_ent)
-        else:
+        if exact and joint.shape[1] * C > max_configs:
+            # Trace-time handover: sample mc_samples configs from the exact
+            # joint; their weights continue the joint-aware greedy.
             exact = False
-            score = cand_bald
+            log_pm = jnp.log(jnp.mean(joint, axis=0) + _EPS)  # [J]
+            key, k_cfg = jax.random.split(key)
+            cfg = jax.random.categorical(k_cfg, log_pm, shape=(mc_samples,))
+            W = joint[:, cfg]  # [S, M]
+            pm = jnp.mean(W, axis=0)
+            offs = jnp.log(pm + _EPS)
+            W = W / (pm[None, :] + _EPS)
+        if exact:
+            h_joint = _joint_entropy_candidates(joint, cand_probs)  # [m]
+        else:
+            # qn[i, m, c] = P(ŷ^m, y_i=c) / P(ŷ^m)
+            qn = jnp.einsum("sm,sic->imc", W, cand_probs) / S
+            h_joint = -jnp.sum(
+                qn * (jnp.log(qn + _EPS) + offs[None, :, None]), axis=(1, 2)
+            ) / mc_samples
+        score = h_joint - (sum_cond + cond_ent)
         score = jnp.where(chosen_mask, -jnp.inf, score)
         j = jnp.argmax(score)
         picked.append(cand[j])
         scores.append(score[j])
         chosen_mask = chosen_mask.at[j].set(True)
         sum_cond = sum_cond + cond_ent[j]
+        p_j = cand_probs[:, j, :]  # [S, C]
         if exact:
             # extend the joint with the picked point's class axis
-            p_j = cand_probs[:, j, :]  # [S, C]
             joint = (joint[:, :, None] * p_j[:, None, :]).reshape(S, -1)
+        else:
+            # extend each sampled config with a class drawn from its
+            # conditional P(y_j | ŷ^m), then renormalize into the offset.
+            cls_logits = jnp.log(jnp.einsum("sm,sc->mc", W, p_j) / S + _EPS)
+            key, k_cls = jax.random.split(key)
+            cls = jax.random.categorical(k_cls, cls_logits, axis=-1)  # [M]
+            W = W * p_j[:, cls]
+            alpha = jnp.mean(W, axis=0)
+            offs = offs + jnp.log(alpha + _EPS)
+            W = W / (alpha[None, :] + _EPS)
 
     return jnp.stack(picked), jnp.stack(scores)
 

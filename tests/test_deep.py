@@ -145,22 +145,62 @@ def test_batchbald_jitted_matches_eager(key):
     np.testing.assert_allclose(np.asarray(scores_jit), np.asarray(scores_eager), atol=1e-5)
 
 
-def test_batchbald_window16_exact_to_fallback_boundary(key):
+def test_batchbald_window16_exact_to_mc_boundary(key):
     """With C=2 and max_configs=64 the joint is exact through pick 6 (2^6=64)
-    and falls back to marginal BALD for picks 7..16 — all 16 picks must be
-    distinct, unlabeled, and returned in one compiled call."""
+    and MC-sampled for picks 7..16 — all 16 picks must be distinct, unlabeled,
+    and returned in one compiled call."""
     p = jax.nn.softmax(jax.random.normal(key, (5, 120, 2)) * 1.5, axis=-1)
     unlabeled = jnp.ones(120, bool).at[:7].set(False)
     picked, scores = deep.batchbald_select(p, unlabeled, k=16, max_configs=64)
     picked = np.asarray(picked)
     assert len(set(picked.tolist())) == 16
     assert (picked >= 7).all()
-    # fallback picks (7+) are ranked by marginal BALD among remaining candidates
-    bald = np.asarray(deep.bald_score(p))
-    chosen = set(picked[:7].tolist())
-    remaining = [i for i in range(120) if i >= 7 and i not in chosen]
-    expected_8th = max(remaining, key=lambda i: bald[i])
-    assert picked[7] == expected_8th
+
+
+def test_batchbald_mc_matches_exact_enumeration(key):
+    """The MC joint estimator must reproduce the exact-enumeration greedy:
+    force MC from pick 2 (max_configs=2 < C^2) with a large sample count and
+    compare against the fully exact run on a small well-separated problem."""
+    p = jax.nn.softmax(jax.random.normal(key, (6, 14, 3)) * 2.0, axis=-1)
+    unlabeled = jnp.ones(14, bool)
+    exact_picks, exact_scores = deep.batchbald_select(
+        p, unlabeled, k=4, max_configs=10_000
+    )
+    mc_picks, mc_scores = deep.batchbald_select(
+        p, unlabeled, k=4, max_configs=3, mc_samples=4096,
+        key=jax.random.key(7),
+    )
+    np.testing.assert_array_equal(np.asarray(mc_picks), np.asarray(exact_picks))
+    # scores are estimates of the same quantity: loose agreement
+    np.testing.assert_allclose(
+        np.asarray(mc_scores), np.asarray(exact_scores), atol=0.05
+    )
+
+
+def test_batchbald_mc_stays_joint_aware_past_cap(key):
+    """BatchBALD's signature behavior — not re-picking near-duplicates of an
+    informative point — must survive past the exact-config cap. The pool is
+    one high-BALD point cloned 6x plus diverse points; marginal BALD (the r3
+    fallback) would fill the batch with clones, the MC joint must not."""
+    S, C = 8, 2
+    k1, k2 = jax.random.split(key)
+    # clone block: high disagreement (p alternates 0.05/0.95 across samples)
+    flip = (jnp.arange(S) % 2).astype(jnp.float32)
+    clone = jnp.stack([0.05 + 0.9 * flip, 0.95 - 0.9 * flip], axis=-1)  # [S, 2]
+    clones = jnp.broadcast_to(clone[:, None, :], (S, 6, C))
+    # diverse block: independent moderate-disagreement points
+    div = jax.nn.softmax(jax.random.normal(k1, (S, 30, C)) * 1.2, axis=-1)
+    p = jnp.concatenate([clones, div], axis=1)  # [S, 36, C]
+    unlabeled = jnp.ones(36, bool)
+    # max_configs=2: exact covers pick 1 only; picks 2..6 are MC
+    picked, _ = deep.batchbald_select(
+        p, unlabeled, k=6, max_configs=2, mc_samples=1024, key=k2
+    )
+    picked = np.asarray(picked)
+    n_clones = int((picked < 6).sum())
+    # marginal BALD ranks all 6 clones on top (max disagreement); the joint
+    # knows clones 2..6 add no information once one is in the batch.
+    assert n_clones <= 2, f"picked {n_clones} clones of 6: not joint-aware"
 
 
 def test_coreset_picks_farthest_cluster_first(key):
