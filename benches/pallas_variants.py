@@ -409,8 +409,10 @@ _SEG_CACHE = {}
 def predict_leaves_segmented(gf: GemmForest, x, bn=2048, bt=8, interpret=False):
     key = (id(gf), id(x), bn, bt)
     if key not in _SEG_CACHE:
-        _SEG_CACHE[key] = _prep_segmented(gf, x, bn, bt)
-    p = _SEG_CACHE[key]
+        # Entry keeps (gf, x) alive so their ids cannot be recycled onto a
+        # different forest/pool while cached (never evicted: bench-lifetime).
+        _SEG_CACHE[key] = (gf, x, _prep_segmented(gf, x, bn, bt))
+    p = _SEG_CACHE[key][2]
     n, n_pad, T, t_pad, i_seg, l_pad, S = p["dims"]
     return _run_segmented(
         p["xT"], p["thr"], p["path"], p["tgt"], p["val_hi"], p["val_lo"],

@@ -121,6 +121,12 @@ def _rotated(cfg: DataConfig) -> DataBundle:
     return _synth(cfg, synthetic.make_rotated_checkerboard, 1000, 1000, "rotated_checkerboard2x2")
 
 
+@register_dataset("blobs4")
+def _blobs4(cfg: DataConfig) -> DataBundle:
+    """4-class Gaussian-blob tabular pool (multiclass forest-loop dataset)."""
+    return _synth(cfg, synthetic.make_blobs, 2000, 2000, "blobs4", n_classes=4)
+
+
 @register_dataset("xor")
 def _xor(cfg: DataConfig) -> DataBundle:
     return _synth(cfg, synthetic.make_xor, 10000, 2000, "xor", d=10)

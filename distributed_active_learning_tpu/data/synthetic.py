@@ -109,6 +109,30 @@ def make_gaussian_unbalanced(
     return train_x, train_y, test_x, test_y
 
 
+def make_blobs(
+    key: jax.Array, n: int, d: int = 4, n_classes: int = 4, spread: float = 2.2
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """C-class Gaussian blobs — the multiclass tabular pool the forest loop
+    shares with the neural loop (the reference is binary-only; this serves
+    the C-class generalization of its strategies). Class means are a FIXED
+    ``spread``-scaled lattice (axis-aligned for c <= d, deterministic
+    otherwise) so independently-keyed train/test draws come from the same
+    mixture (the ``_synth`` split contract); unit-variance clouds, balanced
+    labels.
+    """
+    k_lab, k_pts = jax.random.split(key)
+    if n_classes <= d:
+        means = spread * jnp.eye(n_classes, d, dtype=jnp.float32)
+    else:
+        means = spread * jax.random.normal(
+            jax.random.key(0), (n_classes, d), dtype=jnp.float32
+        )
+    y = jax.random.randint(k_lab, (n,), 0, n_classes)
+    z = jax.random.normal(k_pts, (n, d), dtype=jnp.float32)
+    x = z + means[y]
+    return x.astype(jnp.float32), y.astype(jnp.int32)
+
+
 def make_random_matrix(key: jax.Array, n: int, d: int) -> jnp.ndarray:
     """Dense random matrix like ``sqgen.py`` (vectors_50000x1000.txt) /
     ``cosine_similarity.py:26`` (3000x500 random vectors)."""

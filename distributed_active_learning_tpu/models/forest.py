@@ -27,14 +27,17 @@ from distributed_active_learning_tpu.ops.trees import LEAF, PackedForest, pad_fo
 
 
 def pack_sklearn_forest(
-    model, node_budget: Optional[int] = None, max_depth: Optional[int] = None
+    model, node_budget: Optional[int] = None, max_depth: Optional[int] = None,
+    class_plane: Optional[int] = None,
 ) -> PackedForest:
     """Pack a fitted sklearn forest into dense node tensors.
 
     For classifiers, ``value`` is P(class 1) at each node (vote fractions from
     the node's class counts); for regressors it is the node mean. Trees are
     right-padded with self-looping leaves to the largest node count (or
-    ``node_budget``).
+    ``node_budget``). ``class_plane`` selects which class's probability fills
+    ``value`` (multiclass packing builds one plane per class; ``None`` keeps
+    the binary P(class 1) behavior).
     """
     estimators = model.estimators_
     n_nodes = max(e.tree_.node_count for e in estimators)
@@ -70,13 +73,18 @@ def pack_sklearn_forest(
         right[t, :m] = np.where(leaf_mask, np.arange(m), tr.children_right)
         if is_classifier:
             counts = tr.value[:, 0, :]  # [m, n_classes] (class counts / weights)
-            if counts.shape[1] == 1:
+            totals = counts.sum(axis=1)
+            if class_plane is not None:
+                # P(class_plane): 0 when the fit never saw that class.
+                cols = np.flatnonzero(model.classes_ == class_plane)
+                if len(cols):
+                    value[t, :m] = counts[:, int(cols[0])] / np.maximum(totals, 1e-9)
+            elif counts.shape[1] == 1:
                 # single-class fit (tiny labeled sets early in AL)
                 only = float(model.classes_[0])
                 value[t, :m] = only
             else:
                 pos_col = int(np.flatnonzero(model.classes_ == 1)[0]) if 1 in model.classes_ else 1
-                totals = counts.sum(axis=1)
                 value[t, :m] = counts[:, pos_col] / np.maximum(totals, 1e-9)
         else:
             value[t, :m] = tr.value[:, 0, 0].astype(np.float32)
@@ -92,13 +100,17 @@ def pack_sklearn_forest(
 
 
 def fit_forest_classifier(
-    x: np.ndarray, y: np.ndarray, cfg: ForestConfig, seed: Optional[int] = None
-) -> PackedForest:
+    x: np.ndarray, y: np.ndarray, cfg: ForestConfig, seed: Optional[int] = None,
+    n_classes: Optional[int] = None,
+):
     """Fit a RF classifier on the labeled subset and pack it.
 
     Mirrors ``RandomForest.trainClassifier(numClasses=2, numTrees=cfg.n_trees,
     maxDepth=cfg.max_depth, maxBins=cfg.max_bins, 'gini')``
-    (``uncertainty_sampling.py:71-76``).
+    (``uncertainty_sampling.py:71-76``). With ``n_classes > 2`` (or inferred
+    from ``y``) the result is a :class:`~.ops.trees_multi.MultiForest` of
+    per-class value planes over one fitted structure — the binary path
+    returns the scalar :class:`PackedForest` unchanged.
     """
     model = RandomForestClassifier(
         n_estimators=cfg.n_trees,
@@ -107,8 +119,24 @@ def fit_forest_classifier(
         random_state=cfg.seed if seed is None else seed,
         n_jobs=-1,
     )
-    model.fit(np.asarray(x), np.asarray(y))
-    return pack_sklearn_forest(model, node_budget=cfg.resolved_node_budget, max_depth=cfg.max_depth)
+    y = np.asarray(y)
+    model.fit(np.asarray(x), y)
+    if n_classes is None:
+        n_classes = int(y.max()) + 1 if y.size else 2
+    if n_classes <= 2:
+        return pack_sklearn_forest(
+            model, node_budget=cfg.resolved_node_budget, max_depth=cfg.max_depth
+        )
+    from distributed_active_learning_tpu.ops.trees_multi import MultiForest
+
+    planes = tuple(
+        pack_sklearn_forest(
+            model, node_budget=cfg.resolved_node_budget,
+            max_depth=cfg.max_depth, class_plane=c,
+        )
+        for c in range(n_classes)
+    )
+    return MultiForest(planes=planes)
 
 
 def fit_forest_regressor(

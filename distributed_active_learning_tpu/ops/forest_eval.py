@@ -88,6 +88,14 @@ def for_kernel(forest: trees.PackedForest, kernel: str) -> Forest:
     sklearn fit itself; ``"pallas"`` wraps the same form for the fused VMEM
     kernel; ``"gather"`` keeps the traversal form.
     """
+    from distributed_active_learning_tpu.ops import trees_multi  # lazy: cycle
+
+    if isinstance(forest, trees_multi.MultiForest):
+        # Convert each class plane; structure is shared so every plane gets
+        # the same representation.
+        return trees_multi.MultiForest(
+            planes=tuple(for_kernel(p, kernel) for p in forest.planes)
+        )
     if kernel in ("gemm", "pallas"):
         # The path matrix is O(T · 4^depth); past depth 10 (~4 MB/tree) the
         # form stops paying for itself and would eventually OOM the host, so

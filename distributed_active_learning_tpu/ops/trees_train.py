@@ -119,11 +119,12 @@ def _gini_gain(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("n_trees", "max_depth", "n_bins", "tree_chunk")
+    jax.jit,
+    static_argnames=("n_trees", "max_depth", "n_bins", "tree_chunk", "n_classes"),
 )
 def fit_forest_device(
     codes: jnp.ndarray,     # [m, d] int32 — binned rows (the fit window)
-    y: jnp.ndarray,         # [m] int32 in {0, 1}
+    y: jnp.ndarray,         # [m] int32 in [0, n_classes)
     weights: jnp.ndarray,   # [m] float32 — 0 for invalid/unlabeled rows
     edges: jnp.ndarray,     # [d, n_bins - 1] float32
     key: jax.Array,
@@ -131,16 +132,20 @@ def fit_forest_device(
     max_depth: int,
     n_bins: int = 32,
     tree_chunk: int = 16,
+    n_classes: int = 2,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Train ``n_trees`` complete depth-``max_depth`` trees on device.
 
     Returns heap-layout arrays ``(feature [T, I], threshold [T, I],
-    value [T, 2^(D+1)-1])`` where ``I = 2^D - 1`` internal nodes precede the
-    ``2^D`` leaves; node ``v``'s children are ``2v+1``/``2v+2``.
+    value [T, 2^(D+1)-1, C])`` where ``I = 2^D - 1`` internal nodes precede
+    the ``2^D`` leaves; node ``v``'s children are ``2v+1``/``2v+2``. ``value``
+    rows are per-node class distributions (``C = n_classes``; the histogram
+    GEMM, Gini gains, and routing are class-count-generic, so multiclass costs
+    only a wider class axis).
     """
     m, d = codes.shape
     D = max_depth
-    C = 2
+    C = n_classes
     n_feat_sub = max(int(np.ceil(np.sqrt(d))), 1)
 
     # Shared one-hot binned features [m, d * n_bins] — built once per fit.
@@ -149,7 +154,7 @@ def fit_forest_device(
         .reshape(m, d * n_bins)
         .astype(jnp.bfloat16)
     )
-    y1 = (y == 1)
+    y_oh = jax.nn.one_hot(y, C, dtype=jnp.bfloat16)  # [m, C]
 
     def fit_chunk(args):
         k_chunk = args
@@ -161,7 +166,7 @@ def fit_forest_device(
         # is the measured lever (330 -> 275 ms fit at the bench workload).
         w = jax.random.poisson(k_boot, 1.0, (Tc, m)).astype(jnp.bfloat16)
         w = w * weights[None, :].astype(jnp.bfloat16)
-        wy = jnp.stack([w * (~y1), w * y1], axis=2)  # [Tc, m, C]
+        wy = w[:, :, None] * y_oh[None, :, :]  # [Tc, m, C]
 
         node = jnp.zeros((Tc, m), dtype=jnp.int32)  # level-local node index
         feat_out = []
@@ -227,32 +232,57 @@ def fit_forest_device(
         # Heap-order internal arrays: level l occupies [2^l - 1, 2^(l+1) - 1).
         feature = jnp.concatenate(feat_out, axis=1)      # [Tc, 2^D - 1]
         threshold = jnp.concatenate(thr_out, axis=1)     # [Tc, 2^D - 1]
-        # Node values: P(class 1), empty nodes inherit the parent value.
+        # Node values: class distributions; empty nodes inherit the parent's.
         vals = []
-        root = values[0]
-        root_v = root[..., 1] / jnp.maximum(root.sum(-1), 1e-9)  # [Tc, 1]
-        vals.append(root_v)
+        root = values[0].astype(jnp.float32)
+        root_v = root / jnp.maximum(root.sum(-1, keepdims=True), 1e-9)
+        vals.append(root_v)  # [Tc, 1, C]
         for level in range(1, D + 1):
-            cnt = values[level]  # [Tc, 2^level, C]
-            tot = cnt.sum(-1)
-            v = cnt[..., 1] / jnp.maximum(tot, 1e-9)
+            cnt = values[level].astype(jnp.float32)  # [Tc, 2^level, C]
+            tot = cnt.sum(-1, keepdims=True)
+            v = cnt / jnp.maximum(tot, 1e-9)
             parent_v = jnp.repeat(vals[level - 1], 2, axis=1)
             vals.append(jnp.where(tot > 0, v, parent_v))
-        value = jnp.concatenate(vals, axis=1)  # [Tc, 2^(D+1) - 1]
+        value = jnp.concatenate(vals, axis=1)  # [Tc, 2^(D+1) - 1, C]
         return feature, threshold, value
 
     n_chunks = -(-n_trees // tree_chunk)
     keys = jax.random.split(key, n_chunks)
     feature, threshold, value = jax.lax.map(fit_chunk, keys)
-    merge = lambda t: t.reshape(-1, t.shape[-1])[:n_trees]
+    merge = lambda t: t.reshape(-1, *t.shape[2:])[:n_trees]
     return merge(feature), merge(threshold), merge(value)
+
+
+def _scalar_value_planes(value: jnp.ndarray):
+    """Resolve the trainer's value output into scalar planes.
+
+    ``value`` rank 2 (legacy scalar P(1)) or rank 3 ``[T, nodes, C]``: C=2
+    keeps the binary scalar convention (plane = P(class 1)); C>2 yields one
+    plane per class for a :class:`~.ops.trees_multi.MultiForest`.
+    """
+    if value.ndim == 2:
+        return None, value
+    C = value.shape[-1]
+    if C == 2:
+        return None, value[..., 1]
+    return C, value
 
 
 def heap_packed_forest(
     feature: jnp.ndarray, threshold: jnp.ndarray, value: jnp.ndarray, max_depth: int
-) -> PackedForest:
+):
     """Wrap heap-layout trained arrays as a :class:`PackedForest` (gather
-    kernel compatible; children of ``v`` at ``2v+1``/``2v+2``)."""
+    kernel compatible; children of ``v`` at ``2v+1``/``2v+2``). Multiclass
+    value tensors (``[T, nodes, C]``, C>2) wrap as a ``MultiForest`` of
+    per-class planes sharing the structure arrays."""
+    C, value = _scalar_value_planes(value)
+    if C is not None:
+        from distributed_active_learning_tpu.ops.trees_multi import MultiForest
+
+        return MultiForest(planes=tuple(
+            heap_packed_forest(feature, threshold, value[..., c], max_depth)
+            for c in range(C)
+        ))
     T, I = feature.shape
     n_nodes = 2 * I + 1  # 2^(D+1) - 1
     node = jnp.arange(n_nodes, dtype=jnp.int32)
@@ -301,12 +331,22 @@ def _heap_path_target(depth: int) -> Tuple[np.ndarray, np.ndarray]:
 
 def heap_gemm_forest(
     feature: jnp.ndarray, threshold: jnp.ndarray, value: jnp.ndarray, max_depth: int
-) -> GemmForest:
+):
     """Build the MXU path-matrix form of a device-fit (complete-heap) forest.
 
     Pure slicing + a static constant — jit-friendly, so the full AL round
     (fit + convert + score + select) compiles into one XLA program.
+    Multiclass value tensors wrap as a ``MultiForest`` (one GEMM plane per
+    class over the shared path matrix).
     """
+    C, value = _scalar_value_planes(value)
+    if C is not None:
+        from distributed_active_learning_tpu.ops.trees_multi import MultiForest
+
+        return MultiForest(planes=tuple(
+            heap_gemm_forest(feature, threshold, value[..., c], max_depth)
+            for c in range(C)
+        ))
     T, I = feature.shape
     L = I + 1
     path_np, target_np = _heap_path_target(max_depth)

@@ -64,9 +64,14 @@ def make_round_fn(strategy: Strategy, window_size: int):
 
 
 @jax.jit
-def _accuracy(forest: forest_eval.Forest, test_x: jnp.ndarray, test_y: jnp.ndarray) -> jnp.ndarray:
+def _accuracy(forest, test_x: jnp.ndarray, test_y: jnp.ndarray) -> jnp.ndarray:
     """Test accuracy on device (``uncertainty_sampling.py:79-83``)."""
-    pred = (forest_eval.proba(forest, test_x) > 0.5).astype(jnp.int32)
+    from distributed_active_learning_tpu.ops import trees_multi
+
+    if trees_multi.is_multi(forest):
+        pred = trees_multi.predict_class(forest, test_x)
+    else:
+        pred = (forest_eval.proba(forest, test_x) > 0.5).astype(jnp.int32)
     return jnp.mean((pred == test_y).astype(jnp.float32))
 
 
@@ -109,7 +114,9 @@ def _resolve_fit_budget(cfg: ExperimentConfig, n_pool: int, n_labeled: int) -> i
     return min(caps)
 
 
-def make_device_fit(cfg: ExperimentConfig, edges: jnp.ndarray, budget: int):
+def make_device_fit(
+    cfg: ExperimentConfig, edges: jnp.ndarray, budget: int, n_classes: int = 2
+):
     """Jitted device train phase: labeled-window gather + histogram fit +
     kernel-form conversion, all in one XLA program (no host round-trip —
     the replacement for the JVM fit at ``uncertainty_sampling.py:71-76``)."""
@@ -121,6 +128,19 @@ def make_device_fit(cfg: ExperimentConfig, edges: jnp.ndarray, budget: int):
         and fc.max_depth <= forest_eval._GEMM_MAX_DEPTH
     )
 
+    def _wrap_pallas(forest):
+        # Fused-kernel scoring compares float features in bf16; a point
+        # within bf16 rounding of a threshold can flip a vote
+        # (trees_pallas module docstring — numerics).
+        from distributed_active_learning_tpu.ops.trees_multi import MultiForest
+        from distributed_active_learning_tpu.ops.trees_pallas import PallasForest
+
+        if isinstance(forest, MultiForest):
+            return MultiForest(
+                planes=tuple(PallasForest(gf=p) for p in forest.planes)
+            )
+        return PallasForest(gf=forest)
+
     @jax.jit
     def fit(codes: jnp.ndarray, state: state_lib.PoolState, key: jax.Array):
         mask = state.labeled_mask & state.valid_mask
@@ -128,17 +148,11 @@ def make_device_fit(cfg: ExperimentConfig, edges: jnp.ndarray, budget: int):
         f, th, v = trees_train.fit_forest_device(
             c, yy, w, edges, key,
             n_trees=fc.n_trees, max_depth=fc.max_depth, n_bins=fc.max_bins,
+            n_classes=n_classes,
         )
         if to_gemm:
             gf = trees_train.heap_gemm_forest(f, th, v, fc.max_depth)
-            if fc.kernel == "pallas":
-                # Fused-kernel scoring compares float features in bf16; a
-                # point within bf16 rounding of a threshold can flip a vote
-                # (trees_pallas module docstring — numerics).
-                from distributed_active_learning_tpu.ops.trees_pallas import PallasForest
-
-                return PallasForest(gf=gf)
-            return gf
+            return _wrap_pallas(gf) if fc.kernel == "pallas" else gf
         return trees_train.heap_packed_forest(f, th, v, fc.max_depth)
 
     return fit
@@ -180,8 +194,12 @@ def run_experiment(
     host_x = np.ascontiguousarray(bundle.train_x, dtype=np.float32)
     host_y = np.asarray(bundle.train_y, dtype=np.int32)
 
+    # Class count from the full pool (not the labeled subset, whose early
+    # rounds may miss classes): fixes plane counts so shapes stay static.
+    n_classes = max(int(host_y.max()) + 1, 2) if host_y.size else 2
+
     state = state_lib.init_pool_state(bundle.train_x, bundle.train_y, jax.random.key(cfg.seed))
-    state = state_lib.set_start_state(state, cfg.n_start)
+    state = state_lib.set_start_state(state, cfg.n_start, n_classes=n_classes)
 
     strategy = get_strategy(cfg.strategy)
 
@@ -259,7 +277,7 @@ def run_experiment(
         fit_budget = _resolve_fit_budget(
             cfg, state.n_valid, int(state_lib.labeled_count(state))
         )
-        device_fit = make_device_fit(cfg, binned.edges, fit_budget)
+        device_fit = make_device_fit(cfg, binned.edges, fit_budget, n_classes)
         fit_key = jax.random.key(cfg.seed + 0x5EED)
 
     n_pool = state.n_valid  # real rows only; padding is never selectable
@@ -287,7 +305,10 @@ def run_experiment(
                 jax.block_until_ready(forest)  # keep phase timings honest
             else:
                 lx, ly = _labeled_subset(state, host_x, host_y)
-                packed = fit_forest_classifier(lx, ly, cfg.forest, seed=cfg.seed + round_idx)
+                packed = fit_forest_classifier(
+                    lx, ly, cfg.forest, seed=cfg.seed + round_idx,
+                    n_classes=n_classes,
+                )
                 # One representation conversion per fit; the round + accuracy
                 # then run on the configured kernel (MXU GEMM by default).
                 forest = place_forest(forest_eval.for_kernel(packed, cfg.forest.kernel))

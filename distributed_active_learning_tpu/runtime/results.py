@@ -121,6 +121,45 @@ def plot_result(result: ExperimentResult, path: str, title: str = "") -> str:
     return path
 
 
+def plot_mean_band(named_groups, path: str, title: str = "") -> str:
+    """Overlay per-strategy mean accuracy curves with ±1 sd seed bands.
+
+    ``named_groups``: ``[(label, [log_path, ...]), ...]`` — each group is one
+    strategy's seeds (reference-format logs on a shared n_labeled grid, i.e.
+    same window/rounds). Multi-seed dispersion is the evidence the single-seed
+    overlays of earlier rounds lacked: a strategy claim needs its band clear
+    of the control's, not one lucky trajectory.
+    """
+    import matplotlib
+
+    matplotlib.use("Agg")  # headless
+    import matplotlib.pyplot as plt
+    import numpy as np
+
+    fig, ax = plt.subplots(figsize=(7.5, 4.5))
+    for label, log_paths in named_groups:
+        runs = [parse_reference_log(open(p).read()) for p in log_paths]
+        grid = [r.n_labeled for r in runs[0].records]
+        accs = np.array(
+            [[r.accuracy * 100 for r in run.records] for run in runs]
+        )  # [seeds, rounds]
+        mean = accs.mean(axis=0)
+        sd = accs.std(axis=0)
+        (line,) = ax.plot(grid, mean, label=f"{label} (n={len(runs)})")
+        ax.fill_between(grid, mean - sd, mean + sd, alpha=0.2,
+                        color=line.get_color())
+    ax.set_xlabel("labeled points")
+    ax.set_ylabel("test accuracy (%)")
+    ax.grid(True, alpha=0.3)
+    ax.legend()
+    if title:
+        ax.set_title(title)
+    fig.tight_layout()
+    fig.savefig(path, dpi=120)
+    plt.close(fig)
+    return path
+
+
 def plot_comparison(named_logs, path: str, title: str = "") -> str:
     """Overlay accuracy-vs-labels curves from reference-format logs.
 

@@ -11,7 +11,7 @@ import jax
 import jax.numpy as jnp
 
 from distributed_active_learning_tpu.config import StrategyConfig
-from distributed_active_learning_tpu.ops import forest_eval, scoring, similarity
+from distributed_active_learning_tpu.ops import forest_eval, scoring, similarity, trees_multi
 from distributed_active_learning_tpu.runtime.state import PoolState
 from distributed_active_learning_tpu.strategies.base import (
     Strategy,
@@ -54,6 +54,12 @@ def _uncertainty(cfg: StrategyConfig) -> Strategy:
 
     def score(forest, state, key, aux):
         del key, aux
+        if trees_multi.is_multi(forest):
+            # Multiclass form: top-2 margin ascending (smallest margin =
+            # least confident) — the C-class generalization of the binary
+            # distance-from-0.5 rule.
+            probs = trees_multi.proba_multi(forest, state.x)
+            return trees_multi.margin_score_multi(probs)
         return scoring.uncertainty_score(_vote_fraction(forest, state))
 
     return Strategy(name="uncertainty", score=score, higher_is_better=False)
@@ -71,6 +77,11 @@ def _soft_uncertainty(cfg: StrategyConfig) -> Strategy:
 
     def score(forest, state, key, aux):
         del key, aux
+        if trees_multi.is_multi(forest):
+            # The multiclass posterior is already soft; margin is its
+            # least-confidence form.
+            probs = trees_multi.proba_multi(forest, state.x)
+            return trees_multi.margin_score_multi(probs)
         return scoring.uncertainty_score(forest_eval.proba(forest, state.x))
 
     return Strategy(name="soft_uncertainty", score=score, higher_is_better=False)
@@ -83,6 +94,9 @@ def _entropy(cfg: StrategyConfig) -> Strategy:
 
     def score(forest, state, key, aux):
         del key, aux
+        if trees_multi.is_multi(forest):
+            probs = trees_multi.proba_multi(forest, state.x)
+            return trees_multi.entropy_multi(probs)
         return scoring.positive_entropy(_vote_fraction(forest, state))
 
     return Strategy(name="entropy", score=score, higher_is_better=True)
@@ -94,6 +108,9 @@ def _full_entropy(cfg: StrategyConfig) -> Strategy:
 
     def score(forest, state, key, aux):
         del key, aux
+        if trees_multi.is_multi(forest):
+            probs = trees_multi.proba_multi(forest, state.x)
+            return trees_multi.entropy_multi(probs)
         return scoring.full_entropy(_vote_fraction(forest, state))
 
     return Strategy(name="full_entropy", score=score, higher_is_better=True)
@@ -105,6 +122,9 @@ def _margin(cfg: StrategyConfig) -> Strategy:
 
     def score(forest, state, key, aux):
         del key, aux
+        if trees_multi.is_multi(forest):
+            probs = trees_multi.proba_multi(forest, state.x)
+            return trees_multi.margin_score_multi(probs)
         return scoring.margin_score(_vote_fraction(forest, state))
 
     return Strategy(name="margin", score=score, higher_is_better=False)
@@ -127,7 +147,10 @@ def _density(cfg: StrategyConfig) -> Strategy:
 
     def score(forest, state, key, aux):
         del key
-        ent = scoring.positive_entropy(_vote_fraction(forest, state))
+        if trees_multi.is_multi(forest):
+            ent = trees_multi.entropy_multi(trees_multi.proba_multi(forest, state.x))
+        else:
+            ent = scoring.positive_entropy(_vote_fraction(forest, state))
         if mass_over == "non_seed" and aux.seed_mask is not None:
             count_mask = ~aux.seed_mask
         else:

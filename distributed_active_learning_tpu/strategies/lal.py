@@ -82,6 +82,15 @@ def _lal(cfg: StrategyConfig) -> Strategy:
 
     def score(forest, state, key, aux: StrategyAux):
         del key
+        from distributed_active_learning_tpu.ops.trees_multi import is_multi
+
+        if is_multi(forest):
+            raise ValueError(
+                "the lal strategy is binary-only: its 5 features (positive-"
+                "vote fraction, vote SD, positive-label proportion) are "
+                "defined over a binary forest (active_learner.py:280-296); "
+                "use uncertainty/entropy/margin on multiclass pools"
+            )
         if aux.lal_forest is None:
             raise ValueError(
                 "LAL strategy needs aux.lal_forest (the pretrained error-"

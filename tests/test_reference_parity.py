@@ -123,6 +123,49 @@ def _auc(ds_name, strategy, seed):
     return np.mean([r.accuracy for r in run_experiment(cfg).records])
 
 
+LAL_DATA = os.path.join(os.path.dirname(__file__), "fixtures",
+                        "lal_simulatedunbalanced_big.txt")
+
+
+def _lal_auc(strategy, seed, rounds=50):
+    """Label-efficiency (mean curve accuracy) of single-point AL on the
+    reference's checkerboard2x2 files — the configuration LAL was built for
+    (``classes/active_learner.py:369-384`` runs window-1 AL from nStart=2)."""
+    options = {}
+    if strategy == "lal":
+        options = {
+            "lal_data_path": LAL_DATA,
+            "lal_trees": 300,
+            "lal_depth": 8,
+        }
+    cfg = ExperimentConfig(
+        data=DataConfig(name="checkerboard2x2_file", path=REF_DATA),
+        forest=ForestConfig(n_trees=20, max_depth=8),
+        strategy=StrategyConfig(name=strategy, window_size=1, options=options),
+        n_start=2,
+        max_rounds=rounds,
+        seed=seed,
+    )
+    return np.mean([r.accuracy for r in run_experiment(cfg).records])
+
+
+def test_lal_is_us_competitive_on_reference_fixtures():
+    """r3's LAL curve hovered at ~70% because its regressor was fit on ~160
+    synthesized rows; trained on the committed reference-scale dataset
+    (tests/fixtures/lal_simulatedunbalanced_big.txt, 4000 MC rows) LAL must
+    (a) strictly beat random label-efficiency per seed, and (b) be
+    US-competitive in the seed-mean — checkerboard is the dataset family
+    where Konyushkova et al. motivate LAL over plain uncertainty."""
+    lal, us, rd = [], [], []
+    for seed in range(2):
+        lal.append(_lal_auc("lal", seed))
+        us.append(_lal_auc("uncertainty", seed))
+        rd.append(_lal_auc("random", seed))
+    lal, us, rd = map(np.asarray, (lal, us, rd))
+    assert (lal > rd).all(), (lal, rd)
+    assert lal.mean() >= us.mean() - 0.02, (lal, us)
+
+
 def test_uncertainty_beats_random_on_reference_fixtures_strictly():
     """The headline regression test, made falsifiable (replaces the old
     ``mean(us) >= mean(rand) - 0.02`` slack): on the reference's own
