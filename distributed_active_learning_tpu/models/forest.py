@@ -129,12 +129,29 @@ def fit_forest_classifier(
         )
     from distributed_active_learning_tpu.ops.trees_multi import MultiForest
 
-    planes = tuple(
-        pack_sklearn_forest(
-            model, node_budget=cfg.resolved_node_budget,
-            max_depth=cfg.max_depth, class_plane=c,
-        )
-        for c in range(n_classes)
+    # Pack the structure once; further planes share the structure arrays and
+    # swap only the per-class value tensor (C-fold re-packing would walk every
+    # estimator C times for identical feature/threshold/child arrays).
+    base = pack_sklearn_forest(
+        model, node_budget=cfg.resolved_node_budget,
+        max_depth=cfg.max_depth, class_plane=0,
+    )
+    n_nodes = base.value.shape[1]
+
+    def _plane_values(c: int) -> jnp.ndarray:
+        value = np.zeros((len(model.estimators_), n_nodes), dtype=np.float32)
+        cols = np.flatnonzero(model.classes_ == c)
+        if len(cols):
+            col = int(cols[0])
+            for t, est in enumerate(model.estimators_):
+                counts = est.tree_.value[:, 0, :]
+                value[t, : est.tree_.node_count] = counts[:, col] / np.maximum(
+                    counts.sum(axis=1), 1e-9
+                )
+        return jnp.asarray(value)
+
+    planes = (base,) + tuple(
+        base.replace(value=_plane_values(c)) for c in range(1, n_classes)
     )
     return MultiForest(planes=planes)
 

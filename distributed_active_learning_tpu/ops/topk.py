@@ -14,8 +14,12 @@ from typing import Tuple
 import jax.numpy as jnp
 from jax import lax
 
-NEG_INF = jnp.float32(-jnp.inf)
-POS_INF = jnp.float32(jnp.inf)
+# Plain Python floats, NOT jnp scalars: materializing a device value at
+# import time would initialize the XLA backend before a multi-host launch
+# can call jax.distributed.initialize() (run.py calls it lazily for exactly
+# this reason).
+NEG_INF = float("-inf")
+POS_INF = float("inf")
 
 
 def select_top_k(

@@ -39,10 +39,17 @@ def initialize(
     """Join the multi-host job (wrapper over ``jax.distributed.initialize``).
 
     Arguments default to the ``JAX_COORDINATOR_ADDRESS`` / ``JAX_NUM_PROCESSES``
-    / ``JAX_PROCESS_ID`` environment variables (the standard launcher
-    contract); on Cloud TPU pods all three are auto-detected and may be left
-    unset entirely.
+    / ``JAX_PROCESS_ID`` environment variables (this launcher's contract —
+    resolved here because ``jax.distributed.initialize`` reads the count/id
+    only from cluster-specific detectors); on Cloud TPU pods all three are
+    auto-detected by jax itself and may be left unset entirely.
     """
+    if coordinator_address is None:
+        coordinator_address = os.environ.get(_ENV_COORD)
+    if num_processes is None and os.environ.get(_ENV_NPROC) is not None:
+        num_processes = int(os.environ[_ENV_NPROC])
+    if process_id is None and os.environ.get(_ENV_PID) is not None:
+        process_id = int(os.environ[_ENV_PID])
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,

@@ -136,9 +136,13 @@ def plot_mean_band(named_groups, path: str, title: str = "") -> str:
     import matplotlib.pyplot as plt
     import numpy as np
 
+    def _read(p):
+        with open(p) as f:
+            return f.read()
+
     fig, ax = plt.subplots(figsize=(7.5, 4.5))
     for label, log_paths in named_groups:
-        runs = [parse_reference_log(open(p).read()) for p in log_paths]
+        runs = [parse_reference_log(_read(p)) for p in log_paths]
         grid = [r.n_labeled for r in runs[0].records]
         accs = np.array(
             [[r.accuracy * 100 for r in run.records] for run in runs]
@@ -175,7 +179,8 @@ def plot_comparison(named_logs, path: str, title: str = "") -> str:
 
     fig, ax = plt.subplots(figsize=(7, 4.5))
     for label, log_path in named_logs:
-        res = parse_reference_log(open(log_path).read())
+        with open(log_path) as f:
+            res = parse_reference_log(f.read())
         ax.plot(
             [r.n_labeled for r in res.records],
             [r.accuracy * 100 for r in res.records],

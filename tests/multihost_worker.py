@@ -1,0 +1,72 @@
+"""Worker process for the 2-process jax.distributed test.
+
+Launched by ``tests/test_multihost_2proc.py`` with the explicit coordinator
+env trio set; exercises ``parallel.multihost`` beyond the single-host no-op
+path: real initialization, a cross-process collective, and the
+primary-process-only checkpoint gate.
+
+Prints ``WORKER_OK <process_index>`` on success; any assertion failure makes
+the parent test fail on the exit code + captured output.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+# Cross-process CPU collectives need the gloo transport; without it each
+# process sees only its own devices and the global view never forms.
+os.environ.setdefault("JAX_CPU_COLLECTIVES_IMPLEMENTATION", "gloo")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_active_learning_tpu.parallel import multihost
+
+    assert multihost.maybe_initialize() is True, "env trio should engage init"
+    pid = jax.process_index()
+    assert multihost.process_count() == 2
+    assert multihost.is_primary() == (pid == 0)
+
+    # Cross-process collective: allgather one scalar per process over DCN —
+    # both workers must see [0*10+7, 1*10+7].
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(
+        jnp.asarray([pid * 10 + 7], jnp.int32)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(gathered).reshape(-1), np.asarray([7, 17], np.int32)
+    )
+
+    # Primary-only checkpoint gate: both processes call save(); only process
+    # 0's write may land.
+    from distributed_active_learning_tpu.runtime import checkpoint as ckpt_lib
+    from distributed_active_learning_tpu.runtime import state as state_lib
+    from distributed_active_learning_tpu.runtime.results import ExperimentResult
+
+    ckpt_dir = sys.argv[1]
+    state = state_lib.init_pool_state(
+        jnp.zeros((8, 2), jnp.float32),
+        jnp.zeros((8,), jnp.int32),
+        jax.random.key(0),
+    )
+    path = ckpt_lib.save(ckpt_dir, state, ExperimentResult())
+    assert (path is not None) == (pid == 0), (pid, path)
+
+    # Barrier so the directory is fully written before the parent inspects it.
+    multihost_utils.sync_global_devices("ckpt_written")
+    if pid == 0:
+        files = [f for f in os.listdir(ckpt_dir) if f.endswith(".npz")]
+        assert len(files) == 1, files
+
+    print(f"WORKER_OK {pid}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,69 @@
+"""Two-process jax.distributed integration test (SURVEY §5.8).
+
+Until r4 ``parallel/multihost.py`` had only its single-host no-op path under
+test; the docstring claims (same-program determinism, primary-only checkpoint
+writes) were design intent. This spawns two real processes with a localhost
+coordinator and asserts initialization, a cross-process allgather, and that
+only process 0's checkpoint write lands (``tests/multihost_worker.py``).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)), "multihost_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+def test_two_process_init_collective_and_primary_checkpoint(tmp_path):
+    port = _free_port()
+    ckpt_dir = str(tmp_path / "ckpt")
+    procs = []
+    for pid in (0, 1):
+        env = dict(os.environ)
+        env.update(
+            JAX_PLATFORMS="cpu",
+            JAX_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
+            JAX_NUM_PROCESSES="2",
+            JAX_PROCESS_ID=str(pid),
+        )
+        # The workers must not inherit the suite's forced 8-device CPU flag:
+        # each process contributes its own device(s) to the global view.
+        env.pop("XLA_FLAGS", None)
+        env.pop("TPU_WORKER_HOSTNAMES", None)
+        # A tunnel-attached TPU plugin (when present) force-registers its
+        # backend over JAX_PLATFORMS=cpu; the workers must be pure-CPU.
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, _WORKER, ckpt_dir],
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+        )
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=180)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("multihost worker hung (coordinator barrier?)")
+        outs.append(out)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid} failed:\n{out}"
+        assert f"WORKER_OK {pid}" in out, out
+    # Exactly one checkpoint file: process 1's save() returned None.
+    files = [f for f in os.listdir(ckpt_dir) if f.endswith(".npz")]
+    assert len(files) == 1, files
