@@ -1,0 +1,92 @@
+"""Passive-learning calibration of the deep-AL stand-in pools.
+
+The VERDICT-r3 complaint about the deep-AL evidence was that the stand-in
+pools saturate (100% test accuracy within 8 window-100 rounds), leaving ~2
+rounds of usable strategy separation. This probe measures the *passive*
+accuracy-vs-labels curve — train on a random labeled subset of size L, report
+test accuracy — for the registry stand-ins, so the difficulty knobs in
+``data/synthetic.py`` (modes_per_class / max_shift / imbalance for images;
+topic_frac / overlap / imbalance for tokens) can be set such that the curve is
+still rising at the full >=20-round label budget.
+
+Run on the TPU chip:  python benches/standin_calibration.py [cifar10|agnews]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import numpy as np
+
+from distributed_active_learning_tpu.config import DataConfig
+from distributed_active_learning_tpu.data import get_dataset
+from distributed_active_learning_tpu.models.neural import MLP, NeuralLearner, SmallCNN
+
+
+def passive_curve(name: str, n_samples: int, sizes, train_steps: int, seeds=(0, 1)):
+    accs = {L: [] for L in sizes}
+    for seed in seeds:
+        bundle = get_dataset(DataConfig(name=name, n_samples=n_samples, seed=seed))
+        n_classes = max(int(np.max(bundle.train_y)) + 1, 2)
+        if bundle.train_x.ndim == 4:
+            module = SmallCNN(n_classes=n_classes)
+            input_shape = bundle.train_x.shape[1:]
+            learner = NeuralLearner(module, input_shape, train_steps=train_steps)
+        elif np.issubdtype(np.asarray(bundle.train_x).dtype, np.integer):
+            from distributed_active_learning_tpu.models.transformer import (
+                TransformerClassifier,
+            )
+
+            module = TransformerClassifier(
+                vocab_size=bundle.vocab_size, max_len=bundle.train_x.shape[1],
+                n_classes=n_classes,
+            )
+            learner = NeuralLearner(
+                module, (bundle.train_x.shape[1],), train_steps=train_steps
+            )
+        else:
+            module = MLP(n_classes=n_classes)
+            learner = NeuralLearner(
+                module, (bundle.train_x.shape[1],), train_steps=train_steps
+            )
+
+        x = jax.numpy.asarray(bundle.train_x)
+        y = jax.numpy.asarray(bundle.train_y)
+        rng = np.random.default_rng(seed)
+        for L in sizes:
+            mask = np.zeros(len(bundle.train_x), dtype=bool)
+            mask[rng.choice(len(bundle.train_x), size=L, replace=False)] = True
+            state = learner.init(jax.random.key(seed))
+            t0 = time.time()
+            state = learner.fit_on_mask(
+                state, x, y, jax.numpy.asarray(mask), jax.random.key(seed + 100)
+            )
+            acc = learner.accuracy(
+                state, jax.numpy.asarray(bundle.test_x), jax.numpy.asarray(bundle.test_y)
+            )
+            accs[L].append(acc)
+            print(
+                f"  seed={seed} L={L:5d} acc={acc:.3f}  ({time.time()-t0:.1f}s)",
+                flush=True,
+            )
+    print(f"{name} passive curve (mean over {len(seeds)} seeds):")
+    for L in sizes:
+        print(f"  L={L:5d}  acc={np.mean(accs[L]):.3f} +- {np.std(accs[L]):.3f}")
+    return accs
+
+
+if __name__ == "__main__":
+    which = sys.argv[1] if len(sys.argv) > 1 else "cifar10"
+    if which == "cifar10":
+        # window-100 run: n_start=20, rounds 1..20 -> 120..2020 labels
+        passive_curve("cifar10", n_samples=6000, sizes=[120, 520, 1020, 2020],
+                      train_steps=400)
+    else:
+        # window-50 run: n_start=16, rounds 1..20 -> 66..1016 labels
+        passive_curve("agnews", n_samples=4000, sizes=[66, 266, 516, 1016],
+                      train_steps=400)

@@ -13,7 +13,7 @@ default to the statistically-correct train-fitted scaler and expose
 
 from __future__ import annotations
 
-from typing import Callable, Dict, NamedTuple, Optional
+from typing import Callable, Dict, NamedTuple, Optional, Tuple
 
 import jax
 import numpy as np
@@ -104,6 +104,15 @@ def _synth(cfg: DataConfig, gen, n_train: int, n_test: int, name: str, **kw) -> 
         test_x=np.asarray(test_x), test_y=np.asarray(test_y), name=name,
     )
     return _standardize(bundle, cfg)
+
+
+def _standin_sizes(cfg: DataConfig, default_train: int = 2000) -> Tuple[int, int]:
+    """Pool sizing for the generated deep-AL stand-ins (cifar10/agnews without
+    ``cfg.path``): ``--n-samples`` sets the POOL size (generation, not
+    subsampling); the test set rides on top at 1/5 of the pool, floored at
+    500 so small probe pools still get a stable accuracy estimate."""
+    n_train = cfg.n_samples or default_train
+    return n_train, max(500, n_train // 5)
 
 
 @register_dataset("checkerboard2x2")
@@ -221,10 +230,17 @@ def _cifar10(cfg: DataConfig) -> DataBundle:
     # One draw, then split: the class prototypes are sampled from the key, so
     # separate train/test draws would define two unrelated labelings (test
     # accuracy pinned at chance no matter the learner).
-    x, y = make_synthetic_images(jax.random.key(cfg.seed), 2500)
+    n_train, n_test = _standin_sizes(cfg)
+    # Difficulty (r4 recalibration, v5e sweep): multi-mode shifted prototypes
+    # + geometric class imbalance so a SmallCNN's accuracy-vs-labels curve
+    # rises across >=20 window-100 rounds instead of saturating by round 8.
+    x, y = make_synthetic_images(
+        jax.random.key(cfg.seed), n_train + n_test,
+        noise=3.0, modes_per_class=4, max_shift=8, imbalance=0.18,
+    )
     return DataBundle(
-        np.asarray(x[:2000]), np.asarray(y[:2000]),
-        np.asarray(x[2000:]), np.asarray(y[2000:]), "cifar10",
+        np.asarray(x[:n_train]), np.asarray(y[:n_train]),
+        np.asarray(x[n_train:]), np.asarray(y[n_train:]), "cifar10",
     )
 
 
@@ -252,9 +268,18 @@ def _agnews(cfg: DataConfig) -> DataBundle:
         return DataBundle(train_x, train_y, test_x, test_y, "agnews", vocab_size=vocab)
     from distributed_active_learning_tpu.data.synthetic import make_synthetic_tokens
 
+    # Difficulty (r4 recalibration): thinner topical evidence, neighbouring
+    # topics share vocabulary, geometric class imbalance — so the encoder's
+    # curve rises across >=20 window-50 rounds instead of saturating early.
+    hard = dict(topic_frac=0.35, overlap=0.5, imbalance=0.25)
+    n_train, n_test = _standin_sizes(cfg)
     k_tr, k_te = jax.random.split(jax.random.key(cfg.seed))
-    tx, ty = make_synthetic_tokens(k_tr, 2000, vocab_size=vocab, max_len=max_len)
-    ex, ey = make_synthetic_tokens(k_te, 500, vocab_size=vocab, max_len=max_len)
+    tx, ty = make_synthetic_tokens(
+        k_tr, n_train, vocab_size=vocab, max_len=max_len, **hard
+    )
+    ex, ey = make_synthetic_tokens(
+        k_te, n_test, vocab_size=vocab, max_len=max_len, **hard
+    )
     return DataBundle(
         np.asarray(tx), np.asarray(ty), np.asarray(ex), np.asarray(ey),
         "agnews", vocab_size=vocab,

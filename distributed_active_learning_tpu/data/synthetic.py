@@ -25,6 +25,19 @@ import jax
 import jax.numpy as jnp
 
 
+def _class_labels(
+    key: jax.Array, n: int, n_classes: int, imbalance: float
+) -> jnp.ndarray:
+    """Labels with a geometric class prior ``p_k \\propto (1-imbalance)^k``
+    (``imbalance=0`` = balanced uniform). Rare classes dominate late-curve
+    error, which is where uncertainty-aware acquisition separates from random
+    — the shared difficulty knob of the deep-AL stand-in pools."""
+    if imbalance > 0.0:
+        logp = jnp.arange(n_classes) * jnp.log1p(-imbalance)
+        return jax.random.categorical(key, logp, shape=(n,))
+    return jax.random.randint(key, (n,), 0, n_classes)
+
+
 def make_xor(key: jax.Array, n: int, d: int = 2) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """d-dimensional XOR data: x ~ U[0,1]^d, label = parity of per-dim half-space bits.
 
@@ -146,6 +159,9 @@ def make_synthetic_images(
     hw: int = 32,
     channels: int = 3,
     noise: float = 6.0,
+    modes_per_class: int = 1,
+    max_shift: int = 0,
+    imbalance: float = 0.0,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """CIFAR-shaped stand-in pool: ``[n, hw, hw, c] float32`` + labels.
 
@@ -156,17 +172,36 @@ def make_synthetic_images(
     data/datasets.py:cifar10 with cfg.path.
 
     The prototypes are drawn from ``key``: train/test splits must come from
-    ONE call (slice the result), or their labelings are unrelated. The default
-    ``noise`` is tuned (v5e sweep) so a SmallCNN has an AL-meaningful learning
-    curve rather than a ceiling: ~12% test accuracy at 20 labels, ~61% at 100,
-    ~99% at 400 — accuracy-vs-labels has room to rise across a window-100 run.
+    ONE call (slice the result), or their labelings are unrelated.
+
+    Difficulty knobs (defaults reproduce the single-prototype pool):
+
+    - ``modes_per_class``: each class is a *mixture* of this many independent
+      prototypes. A learner must see samples from every mode of every class,
+      so the learning curve stretches over thousands of labels instead of
+      saturating once the single matched filter is found, and batch-diverse
+      acquisition (BADGE/coreset) has genuine mode-coverage work to do.
+    - ``max_shift``: each sample's prototype is circularly rolled by a random
+      per-sample offset in [-max_shift, max_shift]^2 before noise. The class
+      manifold becomes a shift orbit rather than a point — a stride-conv CNN
+      has to learn the invariance from data, like real image classes.
+    - ``imbalance``: geometric class prior ``p_k \\propto (1-imbalance)^k``
+      (0 = balanced). Rare classes dominate late-curve error, which is where
+      uncertainty-aware acquisition separates from random.
     """
-    k_proto, k_noise, k_lab = jax.random.split(key, 3)
-    # low-frequency prototypes: upsampled 4x4 random patterns
-    coarse = jax.random.normal(k_proto, (n_classes, 4, 4, channels))
-    protos = jax.image.resize(coarse, (n_classes, hw, hw, channels), "bilinear")
-    y = jax.random.randint(k_lab, (n,), 0, n_classes)
-    x = protos[y] + noise * jax.random.normal(k_noise, (n, hw, hw, channels))
+    k_proto, k_noise, k_lab, k_mode, k_shift = jax.random.split(key, 5)
+    # low-frequency prototypes: upsampled 4x4 random patterns, one per mode
+    coarse = jax.random.normal(k_proto, (n_classes, modes_per_class, 4, 4, channels))
+    protos = jax.image.resize(
+        coarse, (n_classes, modes_per_class, hw, hw, channels), "bilinear"
+    )
+    y = _class_labels(k_lab, n, n_classes, imbalance)
+    mode = jax.random.randint(k_mode, (n,), 0, modes_per_class)
+    base = protos[y, mode]
+    if max_shift > 0:
+        shifts = jax.random.randint(k_shift, (n, 2), -max_shift, max_shift + 1)
+        base = jax.vmap(lambda img, s: jnp.roll(img, s, axis=(0, 1)))(base, shifts)
+    x = base + noise * jax.random.normal(k_noise, (n, hw, hw, channels))
     return x.astype(jnp.float32), y.astype(jnp.int32)
 
 
@@ -176,19 +211,37 @@ def make_synthetic_tokens(
     n_classes: int = 4,
     vocab_size: int = 4096,
     max_len: int = 64,
+    topic_frac: float = 0.7,
+    overlap: float = 0.0,
+    imbalance: float = 0.0,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """AG-News-shaped stand-in pool: ``[n, max_len] int32`` token ids + labels.
 
     Each class draws tokens from its own slice of the vocabulary (plus shared
     "stopword" ids), giving a learnable topic-classification signal at the
     exact shape of the hashed AG-News pipeline (data/text.py).
+
+    Difficulty knobs (defaults reproduce the original pool):
+
+    - ``topic_frac``: fraction of positions carrying topical tokens (the rest
+      are uniform "stopwords"). Lowering it thins the per-document evidence.
+    - ``overlap``: each class's token span is widened to spill this fraction
+      into its neighbours' spans, so adjacent topics share vocabulary and the
+      decision needs distributional rather than single-token evidence.
+    - ``imbalance``: geometric class prior ``p_k \\propto (1-imbalance)^k``
+      (0 = balanced); rare topics dominate late-curve error.
     """
     k_lab, k_tok, k_stop, k_mix = jax.random.split(key, 4)
-    y = jax.random.randint(k_lab, (n,), 0, n_classes)
+    y = _class_labels(k_lab, n, n_classes, imbalance)
     span = (vocab_size - 1) // n_classes
-    lo = 1 + y[:, None] * span
-    topic = lo + jax.random.randint(k_tok, (n, max_len), 0, span)
+    wide = int(span * (1.0 + 2.0 * overlap))
+    # Clip the *window start* so every class keeps a full-width span inside
+    # the vocabulary; clamping the drawn ids instead would pile the edge
+    # classes' spillover onto a single boundary token — a one-token class
+    # giveaway that defeats the overlap knob.
+    lo = jnp.clip(1 + y[:, None] * span - int(span * overlap), 1, vocab_size - wide)
+    topic = lo + jax.random.randint(k_tok, (n, max_len), 0, max(wide, 1))
     stop = 1 + jax.random.randint(k_stop, (n, max_len), 0, vocab_size - 1)
-    is_topic = jax.random.uniform(k_mix, (n, max_len)) < 0.7
+    is_topic = jax.random.uniform(k_mix, (n, max_len)) < topic_frac
     ids = jnp.where(is_topic, topic, stop)
     return ids.astype(jnp.int32), y.astype(jnp.int32)
