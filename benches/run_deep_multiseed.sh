@@ -1,0 +1,36 @@
+#!/bin/bash
+# Multi-seed deep-AL curve runs (VERDICT-r3 item 4): the four CIFAR-pool arms
+# and the AG-News BatchBALD arm (plus its random control) at 3 seeds each, on
+# the recalibrated stand-in pools. Runs on the real chip; logs land in
+# results/deep_multiseed/ in the reference's stdout format.
+set -u
+cd "$(dirname "$0")/.."
+OUT=results/deep_multiseed
+mkdir -p "$OUT"
+
+run () { # $1 log name, rest: CLI args
+  local log="$OUT/$1"; shift
+  if [ -s "$log" ]; then echo "skip $log (exists)"; return; fi
+  echo "=== $log"
+  python -m distributed_active_learning_tpu.run "$@" --out "$log" --quiet \
+    || echo "FAILED: $log"
+}
+
+for seed in 0 1 2; do
+  for arm in entropy random badge density; do
+    run "cifar10_cnn_deep_${arm}_window_100_seed${seed}.txt" \
+      --dataset cifar10 --neural --model cnn --strategy "deep.${arm}" \
+      --n-samples 6000 --window 100 --rounds 20 --n-start 20 \
+      --train-steps 400 --mc-samples 8 --seed "$seed"
+  done
+done
+
+for seed in 0 1 2; do
+  for arm in batchbald random; do
+    run "agnews_transformer_deep_${arm}_window_50_seed${seed}.txt" \
+      --dataset agnews --neural --model transformer --strategy "deep.${arm}" \
+      --n-samples 4000 --window 50 --rounds 20 --n-start 16 \
+      --train-steps 400 --mc-samples 8 --seed "$seed"
+  done
+done
+echo ALL_DONE

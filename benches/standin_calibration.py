@@ -28,33 +28,34 @@ from distributed_active_learning_tpu.data import get_dataset
 from distributed_active_learning_tpu.models.neural import MLP, NeuralLearner, SmallCNN
 
 
+def _make_learner(bundle, train_steps: int) -> NeuralLearner:
+    """Bundle-shape -> learner dispatch (the CLI's --model auto rule)."""
+    n_classes = max(int(np.max(bundle.train_y)) + 1, 2)
+    if bundle.train_x.ndim == 4:
+        return NeuralLearner(
+            SmallCNN(n_classes=n_classes), bundle.train_x.shape[1:],
+            train_steps=train_steps,
+        )
+    if np.issubdtype(np.asarray(bundle.train_x).dtype, np.integer):
+        from distributed_active_learning_tpu.models.transformer import (
+            TransformerClassifier,
+        )
+
+        module = TransformerClassifier(
+            vocab_size=bundle.vocab_size, max_len=bundle.train_x.shape[1],
+            n_classes=n_classes,
+        )
+        return NeuralLearner(module, (bundle.train_x.shape[1],), train_steps=train_steps)
+    return NeuralLearner(
+        MLP(n_classes=n_classes), (bundle.train_x.shape[1],), train_steps=train_steps
+    )
+
+
 def passive_curve(name: str, n_samples: int, sizes, train_steps: int, seeds=(0, 1)):
     accs = {L: [] for L in sizes}
     for seed in seeds:
         bundle = get_dataset(DataConfig(name=name, n_samples=n_samples, seed=seed))
-        n_classes = max(int(np.max(bundle.train_y)) + 1, 2)
-        if bundle.train_x.ndim == 4:
-            module = SmallCNN(n_classes=n_classes)
-            input_shape = bundle.train_x.shape[1:]
-            learner = NeuralLearner(module, input_shape, train_steps=train_steps)
-        elif np.issubdtype(np.asarray(bundle.train_x).dtype, np.integer):
-            from distributed_active_learning_tpu.models.transformer import (
-                TransformerClassifier,
-            )
-
-            module = TransformerClassifier(
-                vocab_size=bundle.vocab_size, max_len=bundle.train_x.shape[1],
-                n_classes=n_classes,
-            )
-            learner = NeuralLearner(
-                module, (bundle.train_x.shape[1],), train_steps=train_steps
-            )
-        else:
-            module = MLP(n_classes=n_classes)
-            learner = NeuralLearner(
-                module, (bundle.train_x.shape[1],), train_steps=train_steps
-            )
-
+        learner = _make_learner(bundle, train_steps)
         x = jax.numpy.asarray(bundle.train_x)
         y = jax.numpy.asarray(bundle.train_y)
         rng = np.random.default_rng(seed)
@@ -80,13 +81,55 @@ def passive_curve(name: str, n_samples: int, sizes, train_steps: int, seeds=(0, 
     return accs
 
 
+def ordering_probe(name: str, n_samples: int, window: int, n_start: int,
+                   arms, rounds: int = 10, seeds=(0,), train_steps: int = 400):
+    """Strategy-vs-random ordering at the registry's difficulty settings.
+
+    This is the probe that caught the noise-seeking pathology: with
+    noise-dominated difficulty every strategy *loses* to random (entropy
+    chases the noisiest, least-learnable points), so the stand-ins must put
+    their difficulty in structure — prototype modes, shift orbits, vocabulary
+    overlap, rare classes — for the uncertainty signal to track boundaries.
+    The registry settings in data/datasets.py were chosen where this probe
+    shows strategies ahead AND the passive curve still rises at full budget.
+    """
+    import jax.numpy as jnp
+
+    from distributed_active_learning_tpu.runtime.neural_loop import (
+        NeuralExperimentConfig,
+        run_neural_experiment,
+    )
+
+    for seed in seeds:
+        bundle = get_dataset(DataConfig(name=name, n_samples=n_samples, seed=seed))
+        for arm in arms:
+            lr = _make_learner(bundle, train_steps)
+            cfg = NeuralExperimentConfig(strategy=arm, window_size=window,
+                                         n_start=n_start, max_rounds=rounds,
+                                         seed=seed)
+            res = run_neural_experiment(
+                cfg, lr, jnp.asarray(bundle.train_x), jnp.asarray(bundle.train_y),
+                jnp.asarray(bundle.test_x), jnp.asarray(bundle.test_y))
+            accs = [r.accuracy for r in res.records]
+            print(f"  seed={seed} {arm:10s} auc={np.mean(accs):.3f} "
+                  f"final={accs[-1]:.3f}", flush=True)
+
+
 if __name__ == "__main__":
     which = sys.argv[1] if len(sys.argv) > 1 else "cifar10"
+    mode = sys.argv[2] if len(sys.argv) > 2 else "passive"
     if which == "cifar10":
-        # window-100 run: n_start=20, rounds 1..20 -> 120..2020 labels
-        passive_curve("cifar10", n_samples=6000, sizes=[120, 520, 1020, 2020],
-                      train_steps=400)
+        if mode == "ordering":
+            ordering_probe("cifar10", 6000, 100, 20,
+                           ["entropy", "badge", "random"])
+        else:
+            # window-100 run: n_start=20, rounds 1..20 -> 120..2020 labels
+            passive_curve("cifar10", n_samples=6000, sizes=[120, 520, 1020, 2020],
+                          train_steps=400)
     else:
-        # window-50 run: n_start=16, rounds 1..20 -> 66..1016 labels
-        passive_curve("agnews", n_samples=4000, sizes=[66, 266, 516, 1016],
-                      train_steps=400)
+        if mode == "ordering":
+            ordering_probe("agnews", 4000, 50, 16, ["batchbald", "random"])
+        else:
+            # window-50 run: n_start=16, rounds 1..20 -> 66..1016 labels
+            passive_curve("agnews", n_samples=4000, sizes=[66, 266, 516, 1016],
+                          train_steps=400)

@@ -1,0 +1,81 @@
+"""Summarize the multi-seed deep-AL runs: mean±sd AUC table + band overlays.
+
+Consumes the reference-format logs written by ``benches/run_deep_multiseed.sh``
+into ``results/deep_multiseed/`` and produces:
+
+- ``results/deep_multiseed/cifar10_cnn_curves_multiseed.png`` — the four
+  CIFAR-pool arms, mean curve ±1 sd seed band per arm.
+- ``results/deep_multiseed/agnews_transformer_curves_multiseed.png`` — the
+  AG-News BatchBALD arm vs its random control.
+- A markdown mean±sd table on stdout (pasted into results/README.md).
+
+Usage: python benches/summarize_deep_multiseed.py
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributed_active_learning_tpu.runtime.results import (  # noqa: E402
+    parse_reference_log,
+    plot_mean_band,
+)
+
+OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "results", "deep_multiseed")
+
+
+def _group(pattern):
+    paths = sorted(glob.glob(os.path.join(OUT, pattern)))
+    if not paths:
+        raise SystemExit(f"no logs match {pattern} — run benches/run_deep_multiseed.sh")
+    return paths
+
+
+def _stats(paths):
+    aucs, finals = [], []
+    for p in paths:
+        with open(p) as f:
+            res = parse_reference_log(f.read())
+        accs = [r.accuracy for r in res.records]
+        aucs.append(float(np.mean(accs)))
+        finals.append(accs[-1])
+    return (np.mean(aucs), np.std(aucs), np.mean(finals), np.std(finals), len(paths))
+
+
+def main():
+    print("| pool | arm | label-eff (mean curve acc) | final acc |")
+    print("|---|---|---|---|")
+    cifar_groups, agnews_groups = [], []
+    for arm in ("badge", "entropy", "density", "random"):
+        paths = _group(f"cifar10_cnn_deep_{arm}_window_100_seed*.txt")
+        cifar_groups.append((f"deep.{arm}", paths))
+        am, asd, fm, fsd, n = _stats(paths)
+        print(f"| cifar10 stand-in | deep.{arm} | {am:.3f} ± {asd:.3f} | "
+              f"{fm:.3f} ± {fsd:.3f} |")
+    for arm in ("batchbald", "random"):
+        paths = _group(f"agnews_transformer_deep_{arm}_window_50_seed*.txt")
+        agnews_groups.append((f"deep.{arm}", paths))
+        am, asd, fm, fsd, n = _stats(paths)
+        print(f"| agnews stand-in | deep.{arm} | {am:.3f} ± {asd:.3f} | "
+              f"{fm:.3f} ± {fsd:.3f} |")
+
+    plot_mean_band(
+        cifar_groups, os.path.join(OUT, "cifar10_cnn_curves_multiseed.png"),
+        title="CIFAR-pool deep AL, window 100, 3 seeds (mean ± 1 sd)",
+    )
+    plot_mean_band(
+        agnews_groups, os.path.join(OUT, "agnews_transformer_curves_multiseed.png"),
+        title="AG-News-pool deep AL, window 50, 3 seeds (mean ± 1 sd)",
+    )
+    print("wrote band overlays to", OUT)
+
+
+if __name__ == "__main__":
+    main()

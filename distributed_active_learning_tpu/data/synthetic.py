@@ -234,7 +234,11 @@ def make_synthetic_tokens(
     k_lab, k_tok, k_stop, k_mix = jax.random.split(key, 4)
     y = _class_labels(k_lab, n, n_classes, imbalance)
     span = (vocab_size - 1) // n_classes
-    wide = int(span * (1.0 + 2.0 * overlap))
+    # Cap the widened span at the whole vocabulary: past that point (large
+    # overlap at small n_classes) the classes just share all tokens, and an
+    # uncapped width would push the clip's upper bound below its lower bound
+    # — emitting the reserved padding id 0 and negative ids.
+    wide = min(int(span * (1.0 + 2.0 * overlap)), vocab_size - 1)
     # Clip the *window start* so every class keeps a full-width span inside
     # the vocabulary; clamping the drawn ids instead would pile the edge
     # classes' spillover onto a single boundary token — a one-token class

@@ -248,6 +248,9 @@ def run_neural_experiment(
             net_state = learner.fit_on_mask(
                 net_state, pool_x, state.oracle_y, fit_mask, k_fit
             )
+            # keep phase timings honest: fit_on_mask returns async — without
+            # the block its cost books under the acquire phase
+            jax.block_until_ready(net_state.params)
         train_time = dbg.records[-1][1]
 
         with dbg.phase("acquire"):

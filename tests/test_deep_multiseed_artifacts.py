@@ -1,0 +1,77 @@
+"""Golden regression on the committed multi-seed deep-AL evidence.
+
+The artifacts in ``results/deep_multiseed/`` are the framework's claim that
+its deep acquisition strategies beat random at equal label budget on the
+stand-in pools (BASELINE.json configs 4-5) — 3 seeds per arm, produced by
+``benches/run_deep_multiseed.sh`` on one v5e chip. This test pins that claim
+the same way ``test_reference_parity.py`` pins the forest path's
+US-beats-RAND margin on the reference's own fixtures: if a regression (or a
+re-run with a weaker strategy implementation) lands curves where random wins,
+the suite goes red instead of the evidence silently rotting.
+
+Parse-only — no model training; safe on any backend.
+"""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+from distributed_active_learning_tpu.runtime.results import parse_reference_log
+
+ART = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "results", "deep_multiseed")
+
+
+def _arm(pattern):
+    paths = sorted(glob.glob(os.path.join(ART, pattern)))
+    assert len(paths) >= 3, f"expected >=3 seeds for {pattern}, found {paths}"
+    out = []
+    for p in paths:
+        with open(p) as f:
+            res = parse_reference_log(f.read())
+        accs = [r.accuracy for r in res.records]
+        assert len(accs) == 20, f"{p}: expected 20 rounds, got {len(accs)}"
+        out.append(accs)
+    return np.asarray(out)  # [seeds, rounds]
+
+
+def _final(pattern):
+    return float(_arm(pattern)[:, -1].mean())
+
+
+def _auc(pattern):
+    return float(_arm(pattern).mean())
+
+
+@pytest.mark.parametrize("arm", ["badge", "entropy", "density"])
+def test_cifar_arm_beats_random_final_accuracy(arm):
+    """Committed margins: badge 0.946 / entropy 0.931 / density 0.940 vs
+    random 0.887 (3-seed means; sds <= 0.018). Asserted with >=0.02 slack."""
+    strat = _final(f"cifar10_cnn_deep_{arm}_window_100_seed*.txt")
+    rand = _final("cifar10_cnn_deep_random_window_100_seed*.txt")
+    assert strat > rand + 0.02, (arm, strat, rand)
+
+
+def test_agnews_batchbald_beats_random():
+    """Committed margins: AUC 0.711 vs 0.683, final 0.868 vs 0.822."""
+    bb_auc = _auc("agnews_transformer_deep_batchbald_window_50_seed*.txt")
+    rd_auc = _auc("agnews_transformer_deep_random_window_50_seed*.txt")
+    assert bb_auc > rd_auc + 0.01, (bb_auc, rd_auc)
+    bb_fin = _final("agnews_transformer_deep_batchbald_window_50_seed*.txt")
+    rd_fin = _final("agnews_transformer_deep_random_window_50_seed*.txt")
+    assert bb_fin > rd_fin + 0.02, (bb_fin, rd_fin)
+
+
+def test_curves_do_not_saturate_by_round_8():
+    """The r3 complaint: stand-in pools hit 100% by round 8, leaving no
+    strategy-separation room. Pinned: at round 8 every arm is well below its
+    final accuracy, and no arm's mean curve exceeds 97% before round 15."""
+    for pattern in (
+        "cifar10_cnn_deep_random_window_100_seed*.txt",
+        "agnews_transformer_deep_random_window_50_seed*.txt",
+    ):
+        accs = _arm(pattern).mean(axis=0)
+        assert accs[7] < accs[-1] - 0.05, (pattern, accs)
+        assert float(accs[:14].max()) < 0.97, (pattern, accs)
