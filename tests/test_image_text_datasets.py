@@ -146,7 +146,7 @@ def test_cli_cifar_pickle_branch_end_to_end(tmp_path, capsys):
     rng = np.random.default_rng(3)
     # learnable signal so the run is a real experiment: class k brightens
     # channel k%3 — survives the loader's uint8 -> [-1, 1] scaling
-    for fn, n in [(f"data_batch_{i}", 40) for i in range(1, 6)] + [("test_batch", 40)]:
+    for fn, n in [(f"data_batch_{i}", 12) for i in range(1, 6)] + [("test_batch", 30)]:
         labels = rng.integers(0, 10, size=n)
         data = rng.integers(0, 120, size=(n, 3072), dtype=np.uint8)
         planes = data.reshape(n, 3, 1024)
@@ -155,9 +155,12 @@ def test_cli_cifar_pickle_branch_end_to_end(tmp_path, capsys):
         payload = {b"data": data, b"labels": labels.tolist()}
         with open(os.path.join(tmp_path, fn), "wb") as f:
             pickle.dump(payload, f)
+    # --model mlp: the subject here is the real-format DATA branch, not the
+    # CNN (whose CLI path test_cli_cnn_model_end_to_end covers at 8x8); the
+    # 32x32 SmallCNN compile alone costs ~4 min on the CPU suite.
     rc = main([
         "--dataset", "cifar10", "--data-path", str(tmp_path), "--neural",
-        "--model", "cnn", "--strategy", "deep.entropy", "--window", "10",
+        "--model", "mlp", "--strategy", "deep.entropy", "--window", "10",
         "--rounds", "2", "--n-start", "20", "--train-steps", "30",
         "--mc-samples", "3", "--quiet", "--json",
     ])
@@ -165,9 +168,9 @@ def test_cli_cifar_pickle_branch_end_to_end(tmp_path, capsys):
     lines = [json.loads(l) for l in capsys.readouterr().out.strip().splitlines()]
     assert len(lines) == 2 and lines[-1]["n_labeled"] == 30
     # records are pre-reveal: labeled + unlabeled always sums to the pool,
-    # which is 5 x 40 train rows -> proves the pickle branch (not the 2000-row
+    # which is 5 x 12 train rows -> proves the pickle branch (not the 2000-row
     # stand-in) fed the experiment
-    assert lines[-1]["n_unlabeled"] == 200 - 30
+    assert lines[-1]["n_unlabeled"] == 60 - 30
 
 
 def test_synthetic_tokens_wide_overlap_keeps_ids_in_vocab():
