@@ -34,13 +34,18 @@ has *data-independent* structure: :func:`heap_gemm_forest` builds a
 score + select can run as one jitted program.
 
 Measured split of the device AL round (v5e, 284,807x30 pool, 100 trees,
-depth 8, 5k labeled window): fit 275 ms (330 ms before the bf16 row-weight
-build below), pallas scoring 134 ms. The fit's histogram GEMMs ride the MXU
-in bf16; the remaining cost is the per-level one-hot row-weight build
-(memory-bound elementwise), so further gains would need an incrementally-
-maintained node one-hot — noted, not taken: the device fit is already ~10x
-the host sklearn fit and the whole round sits at ~20,000x the derived Spark
-baseline.
+depth 8, 5k labeled window): fit 115 ms wall / ~25 ms device, pallas scoring
+~23 ms — full round 0.14 s, ~63,000x the derived Spark baseline. The r4
+profile work found the real costs were never the histogram GEMMs (which ride
+the MXU in bf16 and are trivial at this size) but three per-element routing
+GATHERS per level — take_along_axis of the per-row node's (feature, bin) and
+codes[row, feature] — at ~25 ms/level on the v5e; they are now a one-hot
+selector GEMM + membership-masked reduction (gather-free, see the routing
+comment in ``fit_forest_device``), and the bin prefix-sum rides the MXU as a
+triangular matmul instead of lowering to reduce-window. Device time for the
+whole 7-chunk fit is now ~25 ms; the residual wall clock is the tunnel's
+per-program sync latency (~100 ms on the attached-chip rig, absent on a
+local TPU), so further kernel work is not the lever here.
 """
 
 from __future__ import annotations
@@ -146,27 +151,35 @@ def fit_forest_device(
     m, d = codes.shape
     D = max_depth
     C = n_classes
+    if n_bins > 256:
+        # The routing GEMM carries bin codes in bf16 (exact only below 256);
+        # beyond that rows near split boundaries would silently misroute.
+        raise ValueError(f"fit_forest_device supports n_bins <= 256, got {n_bins}")
     n_feat_sub = max(int(np.ceil(np.sqrt(d))), 1)
 
-    # Shared one-hot binned features [m, d * n_bins] — built once per fit.
+    # Shared one-hot (class, bin) features [m, C * d * n_bins] — built once
+    # per fit. Carrying the CLASS axis here (data-dependent only) instead of
+    # on the per-level row-weight operand keeps that operand at [Tc, m, J]:
+    # the level loop's elementwise build — the fit's measured bottleneck —
+    # shrinks by the class factor, and the histogram GEMM cost is unchanged
+    # (same contraction, same output volume).
     bmat = (
         (codes[:, :, None] == jnp.arange(n_bins)[None, None, :])
         .reshape(m, d * n_bins)
         .astype(jnp.bfloat16)
     )
     y_oh = jax.nn.one_hot(y, C, dtype=jnp.bfloat16)  # [m, C]
+    ybmat = (y_oh[:, :, None] * bmat[:, None, :]).reshape(m, C * d * n_bins)
 
     def fit_chunk(args):
         k_chunk = args
         Tc = tree_chunk
         k_boot, k_feat = jax.random.split(k_chunk)
         # Poisson(1) bootstrap weights, zeroed outside the labeled window.
-        # bf16 end-to-end: weights are small integers (exact in bf16), and the
-        # [Tc, m, J*C] one-hot build below is memory-bound — halving its bytes
-        # is the measured lever (330 -> 275 ms fit at the bench workload).
+        # bf16 end-to-end: weights are small integers (exact in bf16) and the
+        # per-level one-hot build below is memory-bound.
         w = jax.random.poisson(k_boot, 1.0, (Tc, m)).astype(jnp.bfloat16)
         w = w * weights[None, :].astype(jnp.bfloat16)
-        wy = w[:, :, None] * y_oh[None, :, :]  # [Tc, m, C]
 
         node = jnp.zeros((Tc, m), dtype=jnp.int32)  # level-local node index
         feat_out = []
@@ -174,26 +187,44 @@ def fit_forest_device(
         values = [
             # Root counts accumulate ~thousands of weights: sum in f32 (bf16
             # addition loses integer exactness past 256).
-            jnp.sum(wy.astype(jnp.float32), axis=1)[:, None, :]  # [Tc, 1, C]
+            jax.lax.dot_general(
+                w, y_oh, dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )[:, None, :]  # [Tc, 1, C]
         ]
+
+        # Bin codes as bf16 for the routing GEMM below: values are small ints
+        # (< n_bins <= 256), exact in bf16.
+        codes_bf = codes.astype(jnp.bfloat16)
 
         for level in range(D):
             J = 1 << level
-            # One-hot (node, class) row weights [Tc, m, J*C].
-            a = (node[:, :, None] == jnp.arange(J)[None, None, :])  # [Tc, m, J]
-            a = (a[:, :, :, None] * wy[:, :, None, :]).reshape(Tc, m, J * C)
+            # Node-membership one-hot [Tc, m, J] — shared by the histogram
+            # GEMM (weighted) and the routing reduction (boolean).
+            a01 = node[:, :, None] == jnp.arange(J)[None, None, :]
+            a = a01.astype(jnp.bfloat16) * w[:, :, None]
             # All histograms of the level in one batched GEMM:
-            # [Tc, J*C, m] x [m, d*n_bins] -> [Tc, J*C, d*n_bins].
+            # [Tc, J, m] x [m, C*d*n_bins] -> [Tc, J, C*d*n_bins].
             hist = jax.lax.dot_general(
-                a.astype(jnp.bfloat16),
-                bmat,
+                a,
+                ybmat,
                 dimension_numbers=(((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
             ).reshape(Tc, J, C, d, n_bins)
 
             parent = values[level]  # [Tc, J, C] — counts computed a level up
-            # Left counts for split-at-bin-b: prefix sums over bins.
-            left = jnp.cumsum(hist, axis=4)[..., : n_bins - 1]  # [Tc,J,C,d,B-1]
+            # Left counts for split-at-bin-b: prefix sums over bins, as a
+            # triangular matmul (cumsum lowers to reduce-window, ~1/3 of the
+            # fit's device time; a [B, B-1] mask contraction rides the MXU).
+            tri = (
+                jnp.arange(n_bins)[:, None] <= jnp.arange(n_bins - 1)[None, :]
+            ).astype(hist.dtype)
+            # precision="highest": counts reach thousands; the default TPU
+            # matmul precision would demote them to bf16 (exact only to 256)
+            # and silently perturb near-tie splits vs the exact cumsum.
+            left = jnp.einsum(
+                "tjcdb,bs->tjcds", hist, tri, precision="highest"
+            )  # [Tc,J,C,d,B-1]
             n_splits = d * (n_bins - 1)
             gain = _gini_gain(left.reshape(Tc, J, C, n_splits), parent)
             gain = gain.reshape(Tc, J, d, n_bins - 1)
@@ -222,11 +253,20 @@ def fit_forest_device(
             )
             values.append(children)
 
-            # Route rows: left iff code[row, feat*] <= bin*.
-            feat_pt = jnp.take_along_axis(bf, node, axis=1)  # [Tc, m]
-            bin_pt = jnp.take_along_axis(bb, node, axis=1)
-            code_pt = codes[jnp.arange(m)[None, :], feat_pt]  # [Tc, m]
-            go_left = code_pt <= bin_pt
+            # Route rows: left iff code[row, feat*(node)] <= bin*(node).
+            # NOT per-element gathers (take_along_axis of [Tc, m] indices +
+            # codes[row, feat] cost ~25 ms/level on a v5e — they were 2/3 of
+            # the whole fit); instead select each node's feature column with
+            # a one-hot GEMM and pick each row's verdict through the already
+            # built membership one-hot — gather-free, MXU/VPU-friendly.
+            sel = jax.nn.one_hot(bf, d, dtype=jnp.bfloat16)  # [Tc, J, d]
+            codef = jax.lax.dot_general(
+                sel, codes_bf,
+                dimension_numbers=(((2,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [Tc, J, m] — exact: small-int values in bf16
+            left_j = codef <= bb[:, :, None].astype(jnp.float32)  # [Tc, J, m]
+            go_left = jnp.any(a01 & left_j.transpose(0, 2, 1), axis=2)
             node = 2 * node + jnp.where(go_left, 0, 1)
 
         # Heap-order internal arrays: level l occupies [2^l - 1, 2^(l+1) - 1).
@@ -368,9 +408,21 @@ def gather_fit_window(
     The labeled set grows every round; gathering it into a static
     ``budget``-row buffer (surplus rows weighted 0) keeps the jitted fit from
     recompiling — the mask-not-shapes rule of SURVEY.md §7 applied to training.
+
+    Compaction is cumsum + scatter, not ``argsort(~mask)``: a full sort of the
+    284k-row benchmark pool cost ~280 ms on a v5e — 900x the histogram fit it
+    was feeding — while the scan/scatter form is bandwidth-bound (~1 ms).
+    Labeled rows land in their stable index order exactly as the stable sort
+    produced; unfilled slots read row 0 at weight 0 (weight is all the fit
+    consumes, so the window is fit-equivalent).
     """
     n = codes.shape[0]
-    order = jnp.argsort(~mask)  # stable: labeled rows first, in index order
-    idx = order[:budget]
-    sel = mask[idx]
+    pos = jnp.cumsum(mask) - 1  # target slot per labeled row, in index order
+    n_labeled = pos[-1] + 1
+    slot = jnp.where(mask & (pos < budget), pos, budget)  # overflow -> dump slot
+    idx = (
+        jnp.zeros((budget + 1,), jnp.int32)
+        .at[slot].set(jnp.arange(n, dtype=jnp.int32), mode="drop")[:budget]
+    )
+    sel = jnp.arange(budget) < n_labeled
     return codes[idx], y[idx], sel.astype(jnp.float32)
