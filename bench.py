@@ -64,6 +64,17 @@ def _peak_flops():
 
 
 def _median_time(fn, iters):
+    """Median wall time of ``fn`` (each fn must end in a device sync).
+
+    Methodology note for the tunnel-attached chip: block_until_ready can
+    return early for SMALL programs there (async completion — measured: a
+    tiny jit reports 0.03 ms), so per-call medians are only trusted for
+    full-workload programs, where queue backpressure makes steady-state
+    wall time track device time; every timed workload in this file is
+    full-pool-sized. Forced host round-trips would instead add the rig's
+    ~100 ms per-program sync latency to every sample (see
+    ops/trees_train.py docstring), overstating small kernels the other way.
+    """
     times = []
     for _ in range(iters):
         t0 = time.perf_counter()

@@ -207,3 +207,21 @@ def test_device_fit_budget_overflow_raises():
     )
     with pytest.raises(ValueError, match="fit window"):
         run_experiment(cfg)
+
+
+def test_gather_fit_window_overflow_and_empty():
+    """Edge cases of the cumsum+scatter compaction (which replaced the slow
+    full-pool argsort): labeled count above the budget truncates to the FIRST
+    budget labeled rows in index order; an all-unlabeled mask yields an
+    all-zero weight window."""
+    codes = jnp.arange(20, dtype=jnp.int32).reshape(10, 2)
+    y = jnp.arange(10, dtype=jnp.int32)
+    # 7 labeled rows, budget 4 -> rows 1,2,3,5 (first four labeled, in order)
+    mask = jnp.asarray([False, True, True, True, False, True, True, True, True, False])
+    c, yy, w = trees_train.gather_fit_window(codes, y, mask, budget=4)
+    np.testing.assert_array_equal(np.asarray(yy), [1, 2, 3, 5])
+    np.testing.assert_array_equal(np.asarray(w), [1, 1, 1, 1])
+
+    empty = jnp.zeros(10, dtype=bool)
+    c, yy, w = trees_train.gather_fit_window(codes, y, empty, budget=4)
+    np.testing.assert_array_equal(np.asarray(w), [0, 0, 0, 0])
