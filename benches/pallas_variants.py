@@ -22,6 +22,18 @@ NOTE: v0 calls whatever ``ops/trees_pallas.py`` currently ships — after the
 r4 redesign landed (the "wf" configuration: transposed, int8 main, bigsel,
 f32 leaf rows) v0 *is* that kernel; the r3 baseline it replaced measured
 1.56-1.70M scores/s in the interleaved runs recorded here.
+
+METHODOLOGY CAVEAT (late r4): every number this script ever printed is a
+per-call WALL median, and the tunnel rig adds a fixed ~90 ms per-program
+sync latency to each call — so all variants sat on a ~90 ms floor and
+genuine device-time differences were compressed into single-digit wall
+percentages. The production kernel's true device time at this workload is
+~23 ms (12.1M scores/s, ~81% of bf16 peak; see ``bench.py::
+_device_time_per_call`` and the corrected note in ``ops/trees_pallas.py``).
+Conclusions drawn here about variant EQUIVALENCE are therefore unreliable;
+the v0>v1>... ordering that picked the shipped configuration still held
+under interleaving, and the shipped kernel's near-roofline device rate
+makes a re-sweep moot.
 """
 
 from __future__ import annotations
