@@ -29,6 +29,12 @@ exact for these {0,1}x{-1,0,1} integers) is *not* faster (0.79M vs 0.83M
 scores/s), while fusing the whole chain in VMEM (``ops/trees_pallas.py``)
 is 2.5x faster at the same FLOP count. Keep this kernel as the exact,
 mesh-shardable default; reach for pallas for raw scoring throughput.
+
+(Measurement caveat, late r4: the figures above are per-call WALL numbers
+from the tunnel rig, which adds ~90 ms fixed sync latency per call — the
+qualitative conclusion stands, but true device-time ratios are larger;
+the pallas kernel's corrected device rate is ~12M scores/s. See
+``ops/trees_pallas.py`` and ``bench.py::_device_time_per_call``.)
 """
 
 from __future__ import annotations
