@@ -16,7 +16,7 @@ run () { # $1 log name, rest: CLI args
     || echo "FAILED: $log"
 }
 
-for seed in 0 1 2; do
+for seed in 0 1 2 3 4; do
   for arm in entropy random badge density; do
     run "cifar10_cnn_deep_${arm}_window_100_seed${seed}.txt" \
       --dataset cifar10 --neural --model cnn --strategy "deep.${arm}" \
@@ -25,7 +25,7 @@ for seed in 0 1 2; do
   done
 done
 
-for seed in 0 1 2; do
+for seed in 0 1 2 3 4; do
   for arm in batchbald random; do
     run "agnews_transformer_deep_${arm}_window_50_seed${seed}.txt" \
       --dataset agnews --neural --model transformer --strategy "deep.${arm}" \
