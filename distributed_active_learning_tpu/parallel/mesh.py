@@ -2,9 +2,11 @@
 
 from __future__ import annotations
 
+import functools
 from typing import Optional, Sequence
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -54,6 +56,44 @@ def replicated_spec() -> P:
     return P()
 
 
+@functools.cache
+def _resharder(sharding: NamedSharding):
+    """One cached jitted identity per target sharding — a fresh lambda per
+    call would retrace and recompile on every forest leaf every round."""
+    return jax.jit(lambda a: a, out_shardings=sharding)
+
+
+def global_put(x, mesh: Mesh, spec: P):
+    """Place ``x`` with ``spec`` on ``mesh``, working for MULTI-PROCESS meshes
+    too. ``jax.device_put`` only accepts fully-addressable shardings; when the
+    mesh spans processes each process holds the same logical value (the
+    multi-controller model), so the global array is assembled per-process via
+    ``make_array_from_callback`` — every process contributes exactly its
+    addressable shards. Typed PRNG keys ride as their uint32 key data.
+    """
+    sharding = NamedSharding(mesh, spec)
+    if sharding.is_fully_addressable:
+        return jax.device_put(x, sharding)
+    if isinstance(x, jax.Array) and getattr(x, "sharding", None) == sharding:
+        return x
+    if isinstance(x, jax.Array) and not x.is_fully_addressable:
+        # Already a global array (e.g. a device-fit forest): reshard inside
+        # jit — host round-trips are impossible for non-addressable data.
+        return _resharder(sharding)(x)
+    if jnp.issubdtype(getattr(x, "dtype", None), jax.dtypes.prng_key):
+        data = np.asarray(jax.random.key_data(x))
+        impl = jax.random.key_impl(x)
+        # key data carries a trailing impl axis the logical spec doesn't name
+        dspec = P(*(tuple(spec) + (None,)))
+        dsharding = NamedSharding(mesh, dspec)
+        global_data = jax.make_array_from_callback(
+            data.shape, dsharding, lambda idx: data[idx]
+        )
+        return jax.random.wrap_key_data(global_data, impl=impl)
+    arr = np.asarray(x)
+    return jax.make_array_from_callback(arr.shape, sharding, lambda idx: arr[idx])
+
+
 def shard_pool_state(state: PoolState, mesh: Mesh) -> PoolState:
     """Place pool arrays with rows sharded over the data axis.
 
@@ -70,11 +110,11 @@ def shard_pool_state(state: PoolState, mesh: Mesh) -> PoolState:
             "runtime.state.pad_for_sharding first"
         )
     return state.replace(
-        x=jax.device_put(state.x, NamedSharding(mesh, pool_spec())),
-        oracle_y=jax.device_put(state.oracle_y, NamedSharding(mesh, mask_spec())),
-        labeled_mask=jax.device_put(state.labeled_mask, NamedSharding(mesh, mask_spec())),
-        key=jax.device_put(state.key, NamedSharding(mesh, replicated_spec())),
-        round=jax.device_put(state.round, NamedSharding(mesh, replicated_spec())),
+        x=global_put(state.x, mesh, pool_spec()),
+        oracle_y=global_put(state.oracle_y, mesh, mask_spec()),
+        labeled_mask=global_put(state.labeled_mask, mesh, mask_spec()),
+        key=global_put(state.key, mesh, replicated_spec()),
+        round=global_put(state.round, mesh, replicated_spec()),
     )
 
 
@@ -96,7 +136,7 @@ def shard_forest(forest, mesh: Mesh):
     """Place a forest with trees sharded over the model axis."""
     specs = forest_tree_specs(forest)
     return jax.tree.map(
-        lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
+        lambda leaf, spec: global_put(leaf, mesh, spec),
         forest,
         specs,
     )

@@ -17,6 +17,13 @@ Host-side responsibilities under multi-host SPMD:
   each process from the same seed, so no cross-host coordination is needed
   beyond the jax.distributed barrier at init;
 - checkpoints should be written by process 0 only (``is_primary``).
+
+Evidence (r4): ``tests/test_multihost_2proc.py`` runs BOTH a
+collective/primary-checkpoint probe and a full forest AL experiment over a
+real 2-process global mesh — GSPMD compiles the fused round into one SPMD
+program spanning the processes, and the curve matches the single-process
+run exactly (host arrays enter through ``parallel.mesh.global_put``, which
+builds global arrays for non-addressable shardings).
 """
 
 from __future__ import annotations
@@ -93,3 +100,25 @@ def is_primary() -> bool:
 
 def process_count() -> int:
     return jax.process_count()
+
+
+def host_np(x):
+    """``np.asarray`` that also works for global arrays spanning processes.
+
+    Fully-addressable (single-process) and fully-replicated global arrays
+    convert directly; a data-sharded multi-process array is allgathered
+    first. COLLECTIVE in that case — every process must call it at the same
+    point (the loop's host round-trips are symmetric across processes, which
+    is what makes this safe).
+    """
+    import numpy as np
+
+    if (
+        isinstance(x, jax.Array)
+        and not x.is_fully_addressable
+        and not x.sharding.is_fully_replicated
+    ):
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+    return np.asarray(x)

@@ -107,8 +107,13 @@ def _base_payload(
     The mask is sliced to real rows so mesh padding never leaks into the file
     (a checkpoint written at ``--mesh-data 8`` must resume at ``--mesh-data 1``).
     """
+    from distributed_active_learning_tpu.parallel.multihost import host_np
+
     payload = {
-        "labeled_mask": np.asarray(state.labeled_mask)[: state.n_valid],
+        # host_np: COLLECTIVE for multi-process data-sharded masks — which is
+        # why save()/save_neural() build the payload BEFORE their primary-only
+        # gate (every process must reach the allgather).
+        "labeled_mask": host_np(state.labeled_mask)[: state.n_valid],
         "key": np.asarray(jax.random.key_data(state.key)),
         "round": np.asarray(int(state.round), dtype=np.int32),
         "records_json": np.frombuffer(
@@ -134,14 +139,14 @@ def save(
     Under multi-host SPMD every process runs the loop; only process 0 writes
     (``parallel.multihost.is_primary``) — returns ``None`` elsewhere.
     """
+    payload = _base_payload(state, result, fingerprint)  # collective: all ranks
     if jax.process_index() != 0:
         return None
     os.makedirs(ckpt_dir, exist_ok=True)
     from distributed_active_learning_tpu.utils.io import atomic_savez
 
     return atomic_savez(
-        os.path.join(ckpt_dir, f"alstate_{int(state.round)}.npz"),
-        **_base_payload(state, result, fingerprint),
+        os.path.join(ckpt_dir, f"alstate_{int(state.round)}.npz"), **payload
     )
 
 

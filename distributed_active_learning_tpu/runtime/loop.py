@@ -88,10 +88,14 @@ def _labeled_subset(
     so only the boolean mask crosses the device boundary per round — not the
     full [n, d] pool.
     """
-    # Slice off mesh-padding rows: host arrays are unpadded.
-    mask = np.asarray(state.labeled_mask)[: state.n_valid]
-    x = (host_x if host_x is not None else np.asarray(state.x)[: state.n_valid])[mask]
-    y = (host_y if host_y is not None else np.asarray(state.oracle_y)[: state.n_valid])[mask]
+    from distributed_active_learning_tpu.parallel.multihost import host_np
+
+    # Slice off mesh-padding rows: host arrays are unpadded. host_np handles
+    # multi-process data-sharded masks (collective; the loop calls this at
+    # the same point on every process).
+    mask = host_np(state.labeled_mask)[: state.n_valid]
+    x = (host_x if host_x is not None else host_np(state.x)[: state.n_valid])[mask]
+    y = (host_y if host_y is not None else host_np(state.oracle_y)[: state.n_valid])[mask]
     return x, y
 
 
@@ -189,6 +193,8 @@ def run_experiment(
 
     test_x = jnp.asarray(bundle.test_x)
     test_y = jnp.asarray(bundle.test_y)
+    # (replicated onto the mesh below once one is configured — required when
+    # the mesh spans processes, harmless single-process)
     # Immutable pool arrays kept host-side: per-round fits index these, so only
     # the labeled mask crosses the device boundary each round.
     host_x = np.ascontiguousarray(bundle.train_x, dtype=np.float32)
@@ -212,6 +218,7 @@ def run_experiment(
         from distributed_active_learning_tpu.parallel import (
             make_mesh,
             make_sharded_round_fn,
+            mesh as mesh_lib,
             shard_forest,
             shard_pool_state,
         )
@@ -233,6 +240,8 @@ def run_experiment(
         state = shard_pool_state(state, mesh)
         round_fn = make_sharded_round_fn(strategy, cfg.strategy.window_size, mesh)
         place_forest = lambda f: shard_forest(f, mesh)
+        test_x = mesh_lib.global_put(test_x, mesh, mesh_lib.replicated_spec())
+        test_y = mesh_lib.global_put(test_y, mesh, mesh_lib.replicated_spec())
     else:
         round_fn = make_round_fn(strategy, cfg.strategy.window_size)
         place_forest = lambda f: f
@@ -279,6 +288,12 @@ def run_experiment(
         )
         device_fit = make_device_fit(cfg, binned.edges, fit_budget, n_classes)
         fit_key = jax.random.key(cfg.seed + 0x5EED)
+        if mesh is not None:
+            # Under a (possibly multi-process) mesh every jit input must be a
+            # global array: codes ride the pool's row sharding, the fit key
+            # is replicated. Single-process meshes pass through device_put.
+            codes = mesh_lib.global_put(codes, mesh, mesh_lib.pool_spec())
+            fit_key = mesh_lib.global_put(fit_key, mesh, mesh_lib.replicated_spec())
 
     n_pool = state.n_valid  # real rows only; padding is never selectable
     round_idx = start_round

@@ -1,0 +1,36 @@
+"""Shared experiment config for the 2-process multihost test.
+
+Lives in its own module with NO import side effects: the worker module
+(``multihost_worker.py``) mutates ``os.environ`` at import time (it must —
+it runs as a subprocess entry point), so the parent pytest process imports
+the config from here instead to keep its own platform selection untouched.
+"""
+
+
+def experiment_cfg(mesh_data: int, checkpoint_dir=None, checkpoint_every=0):
+    """The 2-process experiment configuration — the worker runs it with
+    ``mesh_data=2`` on the global mesh (and per-round checkpointing, which
+    exercises the collective payload gather + primary-only write), the
+    parent test with ``mesh_data=1`` as the single-process reference curve.
+    Pool size divides both axes."""
+    from distributed_active_learning_tpu.config import (
+        DataConfig,
+        ExperimentConfig,
+        ForestConfig,
+        MeshConfig,
+        StrategyConfig,
+    )
+
+    return ExperimentConfig(
+        data=DataConfig(name="checkerboard2x2", seed=5, n_samples=256),
+        forest=ForestConfig(
+            n_trees=8, max_depth=4, fit="device", kernel="gather", fit_budget=64
+        ),
+        strategy=StrategyConfig(name="uncertainty", window_size=8),
+        n_start=10,
+        max_rounds=3,
+        seed=1,
+        mesh=MeshConfig(data=mesh_data),
+        checkpoint_dir=checkpoint_dir,
+        checkpoint_every=checkpoint_every,
+    )

@@ -20,6 +20,38 @@ os.environ.setdefault("JAX_CPU_COLLECTIVES_IMPLEMENTATION", "gloo")
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def run_experiment_mode() -> int:
+    """Full forest AL experiment over a GLOBAL 2-process mesh: pool rows
+    sharded across the two processes' devices, the whole fused round (device
+    fit + score + select + reveal) compiled by GSPMD into one SPMD program
+    spanning DCN. Prints the accuracy curve; the parent asserts it equals the
+    single-process reference — same-program multi-host determinism, the claim
+    SURVEY §5.8's Spark/NCCL analogue actually needs."""
+    import json
+
+    import jax
+
+    from distributed_active_learning_tpu.parallel import multihost
+    from distributed_active_learning_tpu.runtime.loop import run_experiment
+    from tests.multihost_expcfg import experiment_cfg
+
+    assert multihost.maybe_initialize() is True
+    assert multihost.process_count() == 2
+    assert len(jax.devices()) == 2, jax.devices()  # one CPU device per process
+
+    # Per-round checkpointing: the payload gather is a cross-process
+    # collective (host_np on the data-sharded mask), the write is
+    # primary-only — both paths must hold inside the real loop.
+    res = run_experiment(
+        experiment_cfg(mesh_data=2, checkpoint_dir=sys.argv[1], checkpoint_every=1)
+    )
+    accs = [round(r.accuracy, 6) for r in res.records]
+    labeled = [r.n_labeled for r in res.records]
+    print(f"EXPERIMENT_OK {jax.process_index()} "
+          f"{json.dumps({'accs': accs, 'labeled': labeled})}", flush=True)
+    return 0
+
+
 def main() -> int:
     import jax
     import jax.numpy as jnp
@@ -69,4 +101,6 @@ def main() -> int:
 
 
 if __name__ == "__main__":
+    if len(sys.argv) > 2 and sys.argv[2] == "experiment":
+        raise SystemExit(run_experiment_mode())
     raise SystemExit(main())
