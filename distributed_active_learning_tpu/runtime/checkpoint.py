@@ -250,16 +250,18 @@ def save_neural(
     (the reference persists only *models*, never AL state; SURVEY.md §5.4).
     Primary-process-only under multi-host, like :func:`save`.
     """
-    if jax.process_index() != 0:
-        return None
-    os.makedirs(ckpt_dir, exist_ok=True)
-    payload = _base_payload(state, result, fingerprint)
+    payload = _base_payload(state, result, fingerprint)  # collective: all ranks
     payload["loop_key"] = np.asarray(jax.random.key_data(loop_key))
     payload["net_step"] = np.asarray(net_state.step, dtype=np.int32)
+    # Network leaves are replicated (DP) — fully-replicated global arrays
+    # convert directly even when the mesh spans processes.
     for i, leaf in enumerate(jax.tree_util.tree_leaves(net_state.params)):
         payload[f"net_param_{i}"] = np.asarray(leaf)
     for i, leaf in enumerate(jax.tree_util.tree_leaves(net_state.opt_state)):
         payload[f"net_opt_{i}"] = np.asarray(leaf)
+    if jax.process_index() != 0:
+        return None
+    os.makedirs(ckpt_dir, exist_ok=True)
     from distributed_active_learning_tpu.utils.io import atomic_savez
 
     return atomic_savez(

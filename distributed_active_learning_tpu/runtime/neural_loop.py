@@ -134,9 +134,10 @@ def _place_on_mesh(cfg: MeshConfig, state, pool_x, net_state):
     bit-for-bit). The reference's analogue is RDD-partitioning the pool while
     the model rides the driver (SURVEY.md §2.4).
     """
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.sharding import PartitionSpec as P
 
     from distributed_active_learning_tpu.parallel import make_mesh, shard_pool_state
+    from distributed_active_learning_tpu.parallel.mesh import global_put
 
     if cfg.model > 1:
         raise ValueError(
@@ -149,11 +150,10 @@ def _place_on_mesh(cfg: MeshConfig, state, pool_x, net_state):
     pad = state.n_pool - pool_x.shape[0]
     if pad:
         pool_x = jnp.pad(pool_x, ((0, pad),) + ((0, 0),) * (pool_x.ndim - 1))
-    pool_x = jax.device_put(
-        pool_x,
-        NamedSharding(mesh, P("data", *([None] * (pool_x.ndim - 1)))),
-    )
-    net_state = jax.device_put(net_state, NamedSharding(mesh, P()))
+    # global_put: placement works on multi-process meshes too (device_put
+    # only accepts fully-addressable shardings).
+    pool_x = global_put(pool_x, mesh, P("data", *([None] * (pool_x.ndim - 1))))
+    net_state = jax.tree.map(lambda l: global_put(l, mesh, P()), net_state)
     return mesh, state, pool_x, net_state
 
 
@@ -199,10 +199,14 @@ def run_neural_experiment(
         mesh, state, pool_x, net_state = _place_on_mesh(
             cfg.mesh, state, pool_x, net_state
         )
-        # Test arrays ride the mesh replicated so eval shares the round's
-        # device set (mixed committed placements would fail under jit).
-        test_x = jax.device_put(test_x, NamedSharding(mesh, P()))
-        test_y = jax.device_put(test_y, NamedSharding(mesh, P()))
+        # Test arrays and the loop key ride the mesh replicated so every jit
+        # input is global (mixed committed placements fail under jit, and a
+        # process-local input is invalid when the mesh spans processes).
+        from distributed_active_learning_tpu.parallel.mesh import global_put
+
+        test_x = global_put(test_x, mesh, P())
+        test_y = global_put(test_y, mesh, P())
+        key = global_put(key, mesh, P())
     init_net_state = net_state
 
     result = ExperimentResult()

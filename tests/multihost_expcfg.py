@@ -34,3 +34,33 @@ def experiment_cfg(mesh_data: int, checkpoint_dir=None, checkpoint_every=0):
         checkpoint_dir=checkpoint_dir,
         checkpoint_every=checkpoint_every,
     )
+
+
+def neural_experiment(mesh_data: int):
+    """Small MLP deep-AL experiment for the 2-process neural test: returns
+    (accs, labeled) after 2 BALD rounds on a deterministic tabular pool.
+    Same function runs single-process (reference) and on the global mesh."""
+    import numpy as np
+
+    from distributed_active_learning_tpu.config import MeshConfig
+    from distributed_active_learning_tpu.models.neural import MLP, NeuralLearner
+    from distributed_active_learning_tpu.runtime.neural_loop import (
+        NeuralExperimentConfig,
+        run_neural_experiment,
+    )
+
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=(128, 4)).astype(np.float32)
+    y = (x[:, 0] + 0.5 * x[:, 1] > 0).astype(np.int32)
+    tx = rng.normal(size=(64, 4)).astype(np.float32)
+    ty = (tx[:, 0] + 0.5 * tx[:, 1] > 0).astype(np.int32)
+    lr = NeuralLearner(MLP(n_classes=2, hidden=(16,)), (4,), train_steps=20, mc_samples=3)
+    cfg = NeuralExperimentConfig(
+        strategy="bald", window_size=8, n_start=10, max_rounds=2, seed=3,
+        mesh=MeshConfig(data=mesh_data),
+    )
+    res = run_neural_experiment(cfg, lr, x, y, tx, ty)
+    return (
+        [round(r.accuracy, 6) for r in res.records],
+        [r.n_labeled for r in res.records],
+    )

@@ -52,6 +52,26 @@ def run_experiment_mode() -> int:
     return 0
 
 
+def run_neural_mode() -> int:
+    """The NEURAL loop (MLP + MC-dropout BALD) over the 2-process global
+    mesh: DP over pool rows spanning both processes, network replicated.
+    Parent asserts the curve equals the single-process run (threefry is
+    partitionable, so dropout/fit draws match across mesh shapes)."""
+    import json
+
+    import jax
+
+    from distributed_active_learning_tpu.parallel import multihost
+    from tests.multihost_expcfg import neural_experiment
+
+    assert multihost.maybe_initialize() is True
+    assert multihost.process_count() == 2
+    accs, labeled = neural_experiment(mesh_data=2)
+    print(f"NEURAL_OK {jax.process_index()} "
+          f"{json.dumps({'accs': accs, 'labeled': labeled})}", flush=True)
+    return 0
+
+
 def main() -> int:
     import jax
     import jax.numpy as jnp
@@ -103,4 +123,6 @@ def main() -> int:
 if __name__ == "__main__":
     if len(sys.argv) > 2 and sys.argv[2] == "experiment":
         raise SystemExit(run_experiment_mode())
+    if len(sys.argv) > 2 and sys.argv[2] == "neural":
+        raise SystemExit(run_neural_mode())
     raise SystemExit(main())

@@ -128,3 +128,52 @@ def test_two_process_experiment_matches_single_process(tmp_path):
     # processes; only process 0 writes. 3 rounds -> 3 checkpoint files.
     ckpts = sorted(f for f in os.listdir(tmp_path) if f.endswith(".npz"))
     assert len(ckpts) == 3, ckpts
+
+
+@pytest.mark.slow
+def test_two_process_neural_experiment_matches_single_process(tmp_path):
+    """The NEURAL loop across two processes: pool rows DP-sharded over the
+    global mesh, network replicated, MC-dropout acquisition — curve must
+    equal the single-process run (threefry partitionability makes the
+    dropout/fit draws mesh-shape-independent)."""
+    import json
+
+    from tests.multihost_expcfg import neural_experiment
+
+    ref_accs, ref_labeled = neural_experiment(mesh_data=1)
+
+    port = _free_port()
+    procs = []
+    for pid in (0, 1):
+        env = dict(os.environ)
+        env.update(
+            JAX_PLATFORMS="cpu",
+            JAX_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
+            JAX_NUM_PROCESSES="2",
+            JAX_PROCESS_ID=str(pid),
+        )
+        env.pop("XLA_FLAGS", None)
+        env.pop("TPU_WORKER_HOSTNAMES", None)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, _WORKER, str(tmp_path), "neural"],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True,
+            )
+        )
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("multihost neural worker hung")
+        outs.append(out)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid} failed:\n{out}"
+        line = next(l for l in out.splitlines() if l.startswith(f"NEURAL_OK {pid} "))
+        got = json.loads(line.split(" ", 2)[2])
+        assert got["labeled"] == ref_labeled, (pid, got, ref_labeled)
+        assert got["accs"] == pytest.approx(ref_accs, abs=1e-5), (pid, got, ref_accs)
