@@ -18,12 +18,15 @@ Host-side responsibilities under multi-host SPMD:
   beyond the jax.distributed barrier at init;
 - checkpoints should be written by process 0 only (``is_primary``).
 
-Evidence (r4): ``tests/test_multihost_2proc.py`` runs BOTH a
-collective/primary-checkpoint probe and a full forest AL experiment over a
-real 2-process global mesh — GSPMD compiles the fused round into one SPMD
-program spanning the processes, and the curve matches the single-process
-run exactly (host arrays enter through ``parallel.mesh.global_put``, which
-builds global arrays for non-addressable shardings).
+Evidence (r4): ``tests/test_multihost_2proc.py`` runs a
+collective/primary-checkpoint probe AND full AL experiments on BOTH loops
+over a real 2-process global mesh — GSPMD compiles the fused forest round
+and the neural fit/MC-acquire programs into SPMD programs spanning the
+processes, curves match the single-process runs exactly, and per-round
+checkpoints gather collectively with primary-only writes (host arrays
+enter through ``parallel.mesh.global_put``, which builds global arrays
+for non-addressable shardings; host round-trips go through
+:func:`host_np`).
 """
 
 from __future__ import annotations
