@@ -1,0 +1,34 @@
+#!/bin/bash
+# LAL showcase runs (results/lal_showcase/): single-point AL (the reference's
+# LAL configuration, active_learner.py window-1 selection from a 2-point
+# seed) on the reference's own checkerboard2x2 fixture files, LAL's
+# 2000-tree error-reduction regressor trained on the reference-scale
+# Monte-Carlo dataset. Skip-if-exists, so re-running only adds new seeds.
+set -u
+cd "$(dirname "$0")/.."
+OUT=results/lal_showcase
+FIX=tests/fixtures
+mkdir -p "$OUT"
+
+run () { # $1 log name, rest: CLI args
+  local log="$OUT/$1"; shift
+  if [ -s "$log" ]; then echo "skip $log (exists)"; return; fi
+  echo "=== $log"
+  python -m distributed_active_learning_tpu.run "$@" --out "$log" --quiet \
+    || echo "FAILED: $log"
+}
+
+for seed in 0 1 2 3 4; do
+  common=(--dataset checkerboard2x2_file --data-path "$FIX/reference_data"
+          --trees 50 --depth 8 --fit device --window 1 --rounds 200
+          --n-start 2 --seed "$seed")
+  run "checkerboard2x2_distLAL_window_1_seed${seed}.txt" "${common[@]}" \
+    --strategy lal \
+    --strategy-option "lal_data_path=$FIX/lal_simulatedunbalanced_big.txt" \
+    --strategy-option lal_trees=2000
+  run "checkerboard2x2_distUS_window_1_seed${seed}.txt" "${common[@]}" \
+    --strategy uncertainty
+  run "checkerboard2x2_distRAND_window_1_seed${seed}.txt" "${common[@]}" \
+    --strategy random
+done
+echo ALL_DONE

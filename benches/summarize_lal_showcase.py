@@ -1,0 +1,55 @@
+"""Summarize the LAL showcase runs: label-efficiency table + band overlay.
+
+Consumes the logs written by ``benches/run_lal_showcase.sh`` into
+``results/lal_showcase/`` and regenerates the mean±sd table (stdout,
+markdown) plus the seed-band overlay ``lal_vs_us_vs_rand.png``.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributed_active_learning_tpu.runtime.results import (  # noqa: E402
+    parse_reference_log,
+    plot_mean_band,
+)
+
+OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "results", "lal_showcase")
+
+
+def main():
+    print("| arm | label-eff (mean curve acc) | final acc |")
+    print("|---|---|---|")
+    groups = []
+    for arm in ("LAL", "US", "RAND"):
+        paths = sorted(glob.glob(
+            os.path.join(OUT, f"checkerboard2x2_dist{arm}_window_1_seed*.txt")))
+        if not paths:
+            raise SystemExit(f"no logs for {arm} — run benches/run_lal_showcase.sh")
+        groups.append((f"dist{arm}", paths))
+        aucs, finals = [], []
+        for p in paths:
+            with open(p) as f:
+                res = parse_reference_log(f.read())
+            accs = [r.accuracy for r in res.records]
+            aucs.append(float(np.mean(accs)))
+            finals.append(accs[-1])
+        print(f"| dist{arm} ({len(paths)} seeds) | {np.mean(aucs):.3f} ± "
+              f"{np.std(aucs):.3f} | {np.mean(finals):.3f} ± {np.std(finals):.3f} |")
+    plot_mean_band(
+        groups, os.path.join(OUT, "lal_vs_us_vs_rand.png"),
+        title="Single-point AL on the reference's checkerboard2x2 files "
+              "(mean ± 1 sd)",
+    )
+    print("wrote", os.path.join(OUT, "lal_vs_us_vs_rand.png"))
+
+
+if __name__ == "__main__":
+    main()
