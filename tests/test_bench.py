@@ -33,6 +33,9 @@ def test_bench_score_contract(bench):
     r = bench.bench_score(_args())
     assert r["value"] > 0 and r["vs_baseline"] > 0
     assert r["kernel"] == "gemm" and "mfu" not in r or True  # mfu only on TPU
+    # device/wall methodology twins (r4): both present, both positive
+    assert r["wall_seconds_per_query"] > 0 and r["wall_scores_per_sec"] > 0
+    assert r["vs_baseline_wall"] > 0
 
 
 def test_bench_density_contract(bench):
@@ -44,6 +47,7 @@ def test_bench_round_contract(bench):
     r = bench.bench_round(_args())
     assert r["round_seconds"] > 0 and r["round_seconds_host_fit"] > 0
     assert r["vs_baseline"] > 0
+    assert r["round_device_seconds"] > 0 and r["vs_baseline_device"] > 0
 
 
 def test_bench_score_pallas_kernel(bench):
