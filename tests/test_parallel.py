@@ -236,3 +236,27 @@ def test_multihost_pod_detection(monkeypatch):
     monkeypatch.delenv("JAX_NUM_PROCESSES", raising=False)
     assert multihost.maybe_initialize() is False
     assert len(calls) == 1
+
+
+def test_global_put_single_process_branches(devices):
+    """global_put == device_put semantics on fully-addressable meshes, for
+    plain arrays, typed PRNG keys, and already-placed arrays (the
+    multi-process branches are exercised by tests/test_multihost_2proc.py)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distributed_active_learning_tpu.parallel.mesh import global_put, make_mesh
+
+    mesh = make_mesh(data=4, model=2)
+    x = jnp.arange(32, dtype=jnp.float32).reshape(8, 4)
+    placed = global_put(x, mesh, P("data", None))
+    assert placed.sharding == NamedSharding(mesh, P("data", None))
+    np.testing.assert_array_equal(np.asarray(placed), np.asarray(x))
+    # idempotent on an already-placed array
+    again = global_put(placed, mesh, P("data", None))
+    np.testing.assert_array_equal(np.asarray(again), np.asarray(x))
+    # typed PRNG keys place replicated and stay usable
+    key = global_put(jax.random.key(3), mesh, P())
+    draws = jax.random.uniform(key, (4,))
+    np.testing.assert_allclose(
+        np.asarray(draws), np.asarray(jax.random.uniform(jax.random.key(3), (4,)))
+    )
