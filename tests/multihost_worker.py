@@ -36,14 +36,18 @@ def run_experiment_mode() -> int:
     from tests.multihost_expcfg import experiment_cfg
 
     assert multihost.maybe_initialize() is True
-    assert multihost.process_count() == 2
-    assert len(jax.devices()) == 2, jax.devices()  # one CPU device per process
+    nproc = multihost.process_count()
+    assert len(jax.devices()) == nproc, jax.devices()  # one CPU device/process
 
+    fit = sys.argv[3] if len(sys.argv) > 3 else "device"
     # Per-round checkpointing: the payload gather is a cross-process
     # collective (host_np on the data-sharded mask), the write is
-    # primary-only — both paths must hold inside the real loop.
+    # primary-only — both paths must hold inside the real loop. fit="host"
+    # additionally exercises the collective labeled-subset gather + the
+    # same-sklearn-fit-on-every-process determinism story.
     res = run_experiment(
-        experiment_cfg(mesh_data=2, checkpoint_dir=sys.argv[1], checkpoint_every=1)
+        experiment_cfg(mesh_data=nproc, checkpoint_dir=sys.argv[1],
+                       checkpoint_every=1, fit=fit)
     )
     accs = [round(r.accuracy, 6) for r in res.records]
     labeled = [r.n_labeled for r in res.records]

@@ -70,12 +70,19 @@ def test_two_process_init_collective_and_primary_checkpoint(tmp_path):
 
 
 @pytest.mark.slow
-def test_two_process_experiment_matches_single_process(tmp_path):
-    """A REAL forest AL experiment across two processes: pool rows sharded
-    over the global 2-device mesh, the fused round compiled by GSPMD into one
-    SPMD program spanning both. Both workers must produce the SAME curve as a
-    single-process run of the identical config (the mesh-is-performance-only
-    claim, now held across process boundaries, not just virtual devices)."""
+@pytest.mark.parametrize(
+    "nproc,fit", [(2, "device"), (2, "host"), (4, "device")],
+    ids=["2proc-devicefit", "2proc-hostfit", "4proc-devicefit"],
+)
+def test_multi_process_experiment_matches_single_process(tmp_path, nproc, fit):
+    """A REAL forest AL experiment across N processes: pool rows sharded
+    over the global N-device mesh, the fused round compiled by GSPMD into one
+    SPMD program spanning all of them. Every worker must produce the SAME
+    curve as a single-process run of the identical config (the
+    mesh-is-performance-only claim, held across process boundaries, not just
+    virtual devices). fit="host" runs the sklearn fit identically on every
+    process from the collectively-gathered labeled subset; 4 processes check
+    the machinery is not 2-special."""
     import json
 
     # Reference curve in THIS process (8-device virtual mesh env, mesh
@@ -85,18 +92,18 @@ def test_two_process_experiment_matches_single_process(tmp_path):
     from tests.multihost_expcfg import experiment_cfg
     from distributed_active_learning_tpu.runtime.loop import run_experiment
 
-    ref = run_experiment(experiment_cfg(mesh_data=1))
+    ref = run_experiment(experiment_cfg(mesh_data=1, fit=fit))
     ref_accs = [round(r.accuracy, 6) for r in ref.records]
     ref_labeled = [r.n_labeled for r in ref.records]
 
     port = _free_port()
     procs = []
-    for pid in (0, 1):
+    for pid in range(nproc):
         env = dict(os.environ)
         env.update(
             JAX_PLATFORMS="cpu",
             JAX_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
-            JAX_NUM_PROCESSES="2",
+            JAX_NUM_PROCESSES=str(nproc),
             JAX_PROCESS_ID=str(pid),
         )
         env.pop("XLA_FLAGS", None)
@@ -104,7 +111,7 @@ def test_two_process_experiment_matches_single_process(tmp_path):
         env.pop("PALLAS_AXON_POOL_IPS", None)
         procs.append(
             subprocess.Popen(
-                [sys.executable, _WORKER, str(tmp_path), "experiment"],
+                [sys.executable, _WORKER, str(tmp_path), "experiment", fit],
                 env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
                 text=True,
             )
@@ -112,7 +119,7 @@ def test_two_process_experiment_matches_single_process(tmp_path):
     outs = []
     for p in procs:
         try:
-            out, _ = p.communicate(timeout=240)
+            out, _ = p.communicate(timeout=300)
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()
@@ -124,7 +131,7 @@ def test_two_process_experiment_matches_single_process(tmp_path):
         got = json.loads(line.split(" ", 2)[2])
         assert got["labeled"] == ref_labeled, (pid, got, ref_labeled)
         assert got["accs"] == pytest.approx(ref_accs, abs=1e-5), (pid, got, ref_accs)
-    # Per-round checkpoints: the payload gather is collective across both
+    # Per-round checkpoints: the payload gather is collective across all
     # processes; only process 0 writes. 3 rounds -> 3 checkpoint files.
     ckpts = sorted(f for f in os.listdir(tmp_path) if f.endswith(".npz"))
     assert len(ckpts) == 3, ckpts
