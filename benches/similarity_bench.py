@@ -6,6 +6,12 @@ Parity workload for the reference's standalone similarity probes:
 (CoordinateMatrix path). One JSON line per shape.
 
 Usage: python benches/similarity_bench.py [--shapes 3000x500,50000x1000]
+
+Measurement caveat (late r4): per-call wall timings on the tunnel-attached
+rig include a fixed ~90 ms per-program sync latency, and block_until_ready
+can return early for small programs — treat these numbers as end-to-end
+call costs, not kernel device time (see bench.py::_device_time_per_call
+for the differential methodology the headline bench uses).
 """
 
 import argparse
