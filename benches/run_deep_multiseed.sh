@@ -1,6 +1,6 @@
 #!/bin/bash
 # Multi-seed deep-AL curve runs (VERDICT-r3 item 4): the four CIFAR-pool arms
-# and the AG-News BatchBALD arm (plus its random control) at 3 seeds each, on
+# and the AG-News BatchBALD arm (plus its random control) at 5 seeds each, on
 # the recalibrated stand-in pools. Runs on the real chip; logs land in
 # results/deep_multiseed/ in the reference's stdout format.
 set -u

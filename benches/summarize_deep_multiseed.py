@@ -66,13 +66,15 @@ def main():
         print(f"| agnews stand-in | deep.{arm} | {am:.3f} ± {asd:.3f} | "
               f"{fm:.3f} ± {fsd:.3f} |")
 
+    n_cifar = len(cifar_groups[0][1])
+    n_agnews = len(agnews_groups[0][1])
     plot_mean_band(
         cifar_groups, os.path.join(OUT, "cifar10_cnn_curves_multiseed.png"),
-        title="CIFAR-pool deep AL, window 100, 3 seeds (mean ± 1 sd)",
+        title=f"CIFAR-pool deep AL, window 100, {n_cifar} seeds (mean ± 1 sd)",
     )
     plot_mean_band(
         agnews_groups, os.path.join(OUT, "agnews_transformer_curves_multiseed.png"),
-        title="AG-News-pool deep AL, window 50, 3 seeds (mean ± 1 sd)",
+        title=f"AG-News-pool deep AL, window 50, {n_agnews} seeds (mean ± 1 sd)",
     )
     print("wrote band overlays to", OUT)
 

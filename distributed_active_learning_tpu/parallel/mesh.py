@@ -56,10 +56,12 @@ def replicated_spec() -> P:
     return P()
 
 
-@functools.cache
+@functools.lru_cache(maxsize=16)
 def _resharder(sharding: NamedSharding):
     """One cached jitted identity per target sharding — a fresh lambda per
-    call would retrace and recompile on every forest leaf every round."""
+    call would retrace and recompile on every forest leaf every round.
+    Bounded (unlike ``functools.cache``): the key retains the mesh and its
+    compiled executable, and test suites construct many meshes."""
     return jax.jit(lambda a: a, out_shardings=sharding)
 
 

@@ -69,7 +69,11 @@ def test_curves_do_not_saturate_by_round_8():
     strategy-separation room. Pinned: at round 8 every arm is well below its
     final accuracy, and no arm's mean curve exceeds 97% before round 15."""
     for pattern in (
+        "cifar10_cnn_deep_badge_window_100_seed*.txt",
+        "cifar10_cnn_deep_entropy_window_100_seed*.txt",
+        "cifar10_cnn_deep_density_window_100_seed*.txt",
         "cifar10_cnn_deep_random_window_100_seed*.txt",
+        "agnews_transformer_deep_batchbald_window_50_seed*.txt",
         "agnews_transformer_deep_random_window_50_seed*.txt",
     ):
         accs = _arm(pattern).mean(axis=0)
