@@ -99,8 +99,33 @@ def accepted_fingerprints(cfg) -> tuple:
     )
 
 
+def kernel_ident(cfg) -> str:
+    """``"<fit>:<kernel>"`` — recorded in the checkpoint *payload* (not the
+    fingerprint: kernel swaps are legitimate resumes) so :func:`_restore_base`
+    can warn on the one swap that is not vote-exact (host-fit + pallas on
+    either side, see the bf16 note at :func:`_forest_ident`)."""
+    return f"{cfg.forest.fit}:{cfg.forest.kernel}"
+
+
+def _kernel_swap_exact(stored: str, current: str) -> bool:
+    """Whether resuming ``stored`` under ``current`` preserves votes exactly.
+
+    gather/gemm agree bit-for-bit always; the pallas kernel compares features
+    in bfloat16, which is exact for device-fit forests (integer bin codes) but
+    can flip a host-fit vote whose float feature sits within bf16 rounding of
+    a threshold (ops/trees_pallas.py numerics note).
+    """
+    (s_fit, s_kern), (c_fit, c_kern) = stored.split(":", 1), current.split(":", 1)
+    if s_kern == c_kern:
+        return True
+    return "pallas" not in (s_kern, c_kern) or "host" not in (s_fit, c_fit)
+
+
 def _base_payload(
-    state: PoolState, result: ExperimentResult, fingerprint: Optional[str]
+    state: PoolState,
+    result: ExperimentResult,
+    fingerprint: Optional[str],
+    kernel: Optional[str] = None,
 ) -> dict:
     """The checkpoint fields shared by the forest and neural formats.
 
@@ -125,6 +150,8 @@ def _base_payload(
         payload["config_fingerprint"] = np.frombuffer(
             fingerprint.encode(), dtype=np.uint8
         )
+    if kernel is not None:
+        payload["forest_kernel"] = np.frombuffer(kernel.encode(), dtype=np.uint8)
     return payload
 
 
@@ -133,13 +160,14 @@ def save(
     state: PoolState,
     result: ExperimentResult,
     fingerprint: Optional[str] = None,
+    kernel: Optional[str] = None,
 ) -> Optional[str]:
     """Write a checkpoint for the state's current round; returns the path.
 
     Under multi-host SPMD every process runs the loop; only process 0 writes
     (``parallel.multihost.is_primary``) — returns ``None`` elsewhere.
     """
-    payload = _base_payload(state, result, fingerprint)  # collective: all ranks
+    payload = _base_payload(state, result, fingerprint, kernel)  # collective: all ranks
     if jax.process_index() != 0:
         return None
     os.makedirs(ckpt_dir, exist_ok=True)
@@ -162,7 +190,12 @@ def latest_step(ckpt_dir: str) -> Optional[int]:
 
 
 def _restore_base(
-    z, step: int, state: PoolState, result: ExperimentResult, fingerprint: Optional[str]
+    z,
+    step: int,
+    state: PoolState,
+    result: ExperimentResult,
+    fingerprint: Optional[str],
+    kernel: Optional[str] = None,
 ) -> Tuple[PoolState, ExperimentResult]:
     """Rebuild (state, result) from an open npz payload, enforcing the
     fingerprint and pool-size guards and re-applying mesh padding."""
@@ -194,6 +227,26 @@ def _restore_base(
             "config-mismatch guard did not apply",
             stacklevel=3,
         )
+    stored_kernel = (
+        bytes(z["forest_kernel"]).decode() if "forest_kernel" in z.files else None
+    )
+    if (
+        kernel is not None
+        and stored_kernel is not None
+        and stored_kernel != kernel
+        and not _kernel_swap_exact(stored_kernel, kernel)
+    ):
+        import warnings
+
+        warnings.warn(
+            f"resuming a '{stored_kernel}' checkpoint under '{kernel}': the "
+            "pallas kernel compares host-fit float features in bfloat16, so a "
+            "vote whose feature sits within bf16 rounding (~0.4%) of a "
+            "threshold can flip across this swap — the resumed curve may "
+            "diverge from an uninterrupted run (ops/trees_pallas.py numerics "
+            "note)",
+            stacklevel=3,
+        )
     n_stored = mask.shape[0]
     if n_stored == state.n_valid:
         pad = state.n_pool - n_stored
@@ -218,18 +271,20 @@ def restore_latest(
     state: PoolState,
     result: ExperimentResult,
     fingerprint: Optional[str] = None,
+    kernel: Optional[str] = None,
 ) -> Optional[Tuple[PoolState, ExperimentResult]]:
     """Load the newest checkpoint into (state, result); None if none exists.
 
     With ``fingerprint`` set, a stored fingerprint that differs raises — the
     checkpoint belongs to a different experiment (strategy/dataset/forest/seed)
-    and silently continuing it would corrupt the run.
+    and silently continuing it would corrupt the run. With ``kernel`` set
+    (:func:`kernel_ident` form), a swap that is not vote-exact warns.
     """
     step = latest_step(ckpt_dir)
     if step is None:
         return None
     with np.load(os.path.join(ckpt_dir, f"alstate_{step}.npz")) as z:
-        return _restore_base(z, step, state, result, fingerprint)
+        return _restore_base(z, step, state, result, fingerprint, kernel)
 
 
 def save_neural(

@@ -139,6 +139,42 @@ def test_checkpoint_kernel_switch_resumes(tmp_path):
     assert res.records[-1].round == 2  # continued, not refused
 
 
+def test_checkpoint_host_fit_pallas_swap_warns(tmp_path):
+    """gemm<->pallas swaps on a HOST-fit forest are not vote-exact (the
+    pallas kernel compares float features in bf16, trees_pallas numerics
+    note), so the resume must warn; device-fit swaps and same-kernel resumes
+    stay silent."""
+    import warnings
+
+    from distributed_active_learning_tpu.runtime import checkpoint as ckpt_lib
+    from distributed_active_learning_tpu.runtime import state as state_lib
+
+    ckpt = os.path.join(tmp_path, "ckpt")
+    state = state_lib.init_pool_state(
+        np.zeros((20, 2), np.float32), np.zeros(20, np.int32), jax.random.key(0)
+    )
+    ckpt_lib.save(ckpt, state, ExperimentResult(), fingerprint="f", kernel="host:gemm")
+    with pytest.warns(UserWarning, match="bfloat16"):
+        ckpt_lib.restore_latest(
+            ckpt, state, ExperimentResult(), fingerprint="f", kernel="host:pallas"
+        )
+    # Exact swaps are silent: device-fit pallas (integer bin codes) and
+    # host-fit gather<->gemm (bit-identical kernels).
+    for stored, current in (
+        ("device:gemm", "device:pallas"),
+        ("host:gemm", "host:gather"),
+        ("host:pallas", "host:pallas"),
+    ):
+        ckpt_lib.save(
+            ckpt, state, ExperimentResult(), fingerprint="f", kernel=stored
+        )
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            ckpt_lib.restore_latest(
+                ckpt, state, ExperimentResult(), fingerprint="f", kernel=current
+            )
+
+
 def test_checkpoint_mesh_switch_resumes(tmp_path):
     """The mesh is performance-only (sharded round == unsharded round), so a
     checkpoint written on a 2x1 mesh resumes single-device: masks are stored
