@@ -23,7 +23,7 @@ def _args(**kw):
     base = dict(
         mode="score", pool=1500, features=6, trees=5, depth=4, window=10,
         iters=1, train_rows=150, lal_trees=10, lal_pool=120, kernel="gemm",
-        neural_pool=64, train_steps=5, mc_samples=2,
+        neural_pool=64, train_steps=5, mc_samples=2, mesh_data=0, mesh_model=1,
     )
     base.update(kw)
     return argparse.Namespace(**base)
@@ -36,6 +36,32 @@ def test_bench_score_contract(bench):
     # device/wall methodology twins (r4): both present, both positive
     assert r["wall_seconds_per_query"] > 0 and r["wall_scores_per_sec"] > 0
     assert r["vs_baseline_wall"] > 0
+    # r5: every device-time number names its methodology
+    assert r["device_time_method"] in ("differential", "wall_fallback")
+
+
+def test_rig_health_probe(bench):
+    """The calibration probe must always produce the self-diagnosis keys; on
+    CPU there is no published peak, so mfu is None and degraded stays False
+    (a missing peak must never read as a degraded rig)."""
+    h = bench.rig_health()
+    assert h["rig_health_gemm_seconds"] > 0
+    assert h["rig_health_method"] in ("differential", "wall_fallback")
+    import jax
+
+    if jax.default_backend() != "tpu":
+        assert h["rig_health_mfu"] is None
+        assert h["degraded_rig"] is False
+
+
+def test_run_with_health_wraps_mode(bench):
+    """The driver entry path: one JSON payload with health + schema keys on
+    top of the mode's own metrics."""
+    out = bench.run_with_health(_args(mode="score"))
+    assert out["metric"] == "acquisition_scores_per_sec"
+    assert out["bench_schema"] == 2
+    assert "rig_health_mfu" in out and "degraded_rig" in out
+    assert out["rig_health_method"] in ("differential", "wall_fallback")
 
 
 def test_bench_density_contract(bench):
@@ -53,3 +79,19 @@ def test_bench_round_contract(bench):
 def test_bench_score_pallas_kernel(bench):
     r = bench.bench_score(_args(kernel="pallas"))
     assert r["kernel"] == "pallas" and r["value"] > 0
+
+
+def test_bench_score_mesh_path_pads_odd_pools(bench):
+    """--mesh-data with a pool size the data axis does not divide (the
+    default 284,807 is odd) must pad rather than crash in device_put; the
+    sharded kernel's answer stays equivalent to the direct one."""
+    r = bench.bench_score(_args(kernel="pallas", pool=1501, mesh_data=2))
+    assert r["kernel"] == "pallas+mesh2x1" and r["value"] > 0
+
+
+def test_bench_neural_tiny_pool_keeps_candidates(bench):
+    """The window/seed-count clamps must leave real unlabeled candidates on
+    tiny smoke pools (the forest-bench --window default is 100)."""
+    r = bench.bench_neural(_args())
+    assert r["cnn_round_seconds"] > 0
+    assert r["transformer_batchbald_round_seconds"] > 0
