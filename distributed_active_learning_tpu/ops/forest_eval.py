@@ -40,7 +40,9 @@ def _is_gemm(forest: Forest) -> bool:
 
 
 def _is_pallas(forest: Forest) -> bool:
-    return isinstance(forest, trees_pallas.PallasForest)
+    return isinstance(
+        forest, (trees_pallas.PallasForest, trees_pallas.ShardedPallasForest)
+    )
 
 
 def leaves(forest: Forest, x: jnp.ndarray) -> jnp.ndarray:

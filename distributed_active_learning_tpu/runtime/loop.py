@@ -228,18 +228,21 @@ def run_experiment(
                 f"n_trees={cfg.forest.n_trees} not divisible by mesh "
                 f"model axis {cfg.mesh.model}"
             )
-        if cfg.forest.kernel == "pallas":
-            # pallas_call has no GSPMD partitioning rule; the gemm form is
-            # bit-identical and shards, so multi-device rounds use it.
-            dbg.debug("mesh>1: kernel 'pallas' falls back to 'gemm' (sharded)")
-            cfg = dataclasses.replace(
-                cfg, forest=dataclasses.replace(cfg.forest, kernel="gemm")
-            )
         mesh = make_mesh(data=cfg.mesh.data, model=cfg.mesh.model)
         state = state_lib.pad_for_sharding(state, cfg.mesh.data)
         state = shard_pool_state(state, mesh)
         round_fn = make_sharded_round_fn(strategy, cfg.strategy.window_size, mesh)
-        place_forest = lambda f: shard_forest(f, mesh)
+        if cfg.forest.kernel == "pallas":
+            # pallas_call has no GSPMD partitioning rule, so the fused kernel
+            # runs per-shard under shard_map instead (rows over data, trees
+            # over model) — multi-device rounds keep the flagship kernel
+            # rather than silently dropping to the ~20x slower GEMM form
+            # (the r4 gap; see ops.trees_pallas.ShardedPallasForest).
+            from distributed_active_learning_tpu.ops.trees_pallas import attach_mesh
+
+            place_forest = lambda f: attach_mesh(shard_forest(f, mesh), mesh)
+        else:
+            place_forest = lambda f: shard_forest(f, mesh)
         test_x = mesh_lib.global_put(test_x, mesh, mesh_lib.replicated_spec())
         test_y = mesh_lib.global_put(test_y, mesh, mesh_lib.replicated_spec())
     else:
@@ -258,9 +261,11 @@ def run_experiment(
         from distributed_active_learning_tpu.runtime import checkpoint as ckpt_lib
 
         ckpt_fp = ckpt_lib.config_fingerprint(cfg)
+        ckpt_kernel = ckpt_lib.kernel_ident(cfg)
         restored = ckpt_lib.restore_latest(
             cfg.checkpoint_dir, state, result,
             fingerprint=ckpt_lib.accepted_fingerprints(cfg),
+            kernel=ckpt_kernel,
         )
         if restored is not None:
             state, result = restored
@@ -359,7 +364,10 @@ def run_experiment(
         if cfg.checkpoint_dir and cfg.checkpoint_every and round_idx % cfg.checkpoint_every == 0:
             from distributed_active_learning_tpu.runtime import checkpoint as ckpt_lib
 
-            ckpt_lib.save(cfg.checkpoint_dir, state, result, fingerprint=ckpt_fp)
+            ckpt_lib.save(
+                cfg.checkpoint_dir, state, result,
+                fingerprint=ckpt_fp, kernel=ckpt_kernel,
+            )
 
     if cfg.results_path:
         result.save(cfg.results_path, fmt="reference")

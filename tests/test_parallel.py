@@ -81,6 +81,65 @@ def test_sharded_votes_path_matrix_kernels(devices, setup, kernel):
     np.testing.assert_array_equal(got, want)
 
 
+def test_sharded_pallas_forest_matches_unsharded(devices, setup):
+    """r5: the fused kernel itself shards — a ShardedPallasForest evaluates
+    per (data, model) shard under shard_map inside plain jit, so multi-device
+    rounds keep the flagship kernel instead of falling back to the GEMM form
+    (the r4 gap, runtime/loop.py). Votes are exact integers: sharded ==
+    unsharded bit-for-bit, including on row counts NOT divisible by the data
+    axis (the test-split case, padded internally)."""
+    from distributed_active_learning_tpu.ops import forest_eval
+    from distributed_active_learning_tpu.ops.trees_pallas import (
+        ShardedPallasForest,
+        attach_mesh,
+    )
+
+    forest, state = setup
+    mesh = make_mesh(data=4, model=2)
+    f = forest_eval.for_kernel(forest, "pallas")
+    f_sh = attach_mesh(shard_forest(f, mesh), mesh)
+    assert isinstance(f_sh, ShardedPallasForest)
+    assert f_sh.n_trees == f.n_trees
+
+    want_votes = np.asarray(forest_eval.votes(f, state.x))
+    want_proba = np.asarray(forest_eval.proba(f, state.x))
+    got_votes = np.asarray(jax.jit(forest_eval.votes)(f_sh, state.x))
+    got_proba = np.asarray(jax.jit(forest_eval.proba)(f_sh, state.x))
+    np.testing.assert_array_equal(got_votes, want_votes)
+    np.testing.assert_allclose(got_proba, want_proba, atol=1e-6)
+
+    # Non-divisible row count (250 % 4 != 0): padded inside, sliced back.
+    x_odd = state.x[:250]
+    np.testing.assert_array_equal(
+        np.asarray(jax.jit(forest_eval.votes)(f_sh, x_odd)),
+        want_votes[:250],
+    )
+
+
+def test_sharded_round_pallas_kernel_matches_unsharded(devices, setup):
+    """The GSPMD round driven by a ShardedPallasForest picks the same points
+    and scores as the single-device pallas round."""
+    from distributed_active_learning_tpu.ops import forest_eval
+    from distributed_active_learning_tpu.ops.trees_pallas import attach_mesh
+    from distributed_active_learning_tpu.runtime.loop import make_round_fn
+
+    forest, state = setup
+    strat = get_strategy(StrategyConfig(name="uncertainty", window_size=6))
+    f = forest_eval.for_kernel(forest, "pallas")
+    single = make_round_fn(strat, 6)
+    aux = StrategyAux(seed_mask=state.labeled_mask)
+    _, s_picked, s_scores = single(f, state, aux)
+
+    mesh = make_mesh(data=4, model=2)
+    sharded = make_sharded_round_fn(strat, 6, mesh)
+    st_sh = shard_pool_state(state, mesh)
+    f_sh = attach_mesh(shard_forest(f, mesh), mesh)
+    _, m_picked, m_scores = sharded(f_sh, st_sh, StrategyAux(seed_mask=st_sh.labeled_mask))
+
+    np.testing.assert_allclose(np.asarray(s_scores), np.asarray(m_scores), atol=1e-6)
+    assert set(np.asarray(s_picked).tolist()) == set(np.asarray(m_picked).tolist())
+
+
 def test_sharded_mass_matches_single_device(devices, setup):
     _, state = setup
     mesh = make_mesh(data=8, model=1)
@@ -130,10 +189,16 @@ def test_sharded_round_output_stays_sharded(devices, setup):
     assert not sh.is_fully_replicated
 
 
-def test_sharded_experiment_matches_single_device():
+@pytest.mark.parametrize(
+    "kernel,fit",
+    [("gemm", "host"), ("pallas", "host"), ("pallas", "device")],
+)
+def test_sharded_experiment_matches_single_device(kernel, fit):
     """run_experiment with a 4x2 MeshConfig and a non-divisible pool (250 rows
     padded to 252) must produce the same curve as the single-device run — the
-    sharding is a placement decision, not a semantic one."""
+    sharding is a placement decision, not a semantic one. Includes the pallas
+    kernel (r5: shard_map-wrapped, no more silent gemm fallback) on both the
+    host-fit and fully-on-device fit paths."""
     from distributed_active_learning_tpu.config import (
         DataConfig,
         ExperimentConfig,
@@ -144,7 +209,7 @@ def test_sharded_experiment_matches_single_device():
     def cfg(mesh):
         return ExperimentConfig(
             data=DataConfig(name="checkerboard2x2", n_samples=250, seed=2),
-            forest=ForestConfig(n_trees=8, max_depth=4),
+            forest=ForestConfig(n_trees=8, max_depth=4, kernel=kernel, fit=fit),
             strategy=StrategyConfig(name="uncertainty", window_size=10),
             mesh=mesh,
             n_start=10,
