@@ -141,6 +141,15 @@ def _xor(cfg: DataConfig) -> DataBundle:
     return _synth(cfg, synthetic.make_xor, 10000, 2000, "xor", d=10)
 
 
+@register_dataset("striatum_like")
+def _striatum_like(cfg: DataConfig) -> DataBundle:
+    """10k-pool striatum stand-in (d=50 oblique boundary, minority positives)
+    — the scale-run dataset for BASELINE.md's window-10/50/100 US-vs-RAND
+    rows; see :func:`synthetic.make_striatum_like` for why this geometry and
+    not a checkerboard."""
+    return _synth(cfg, synthetic.make_striatum_like, 10000, 10000, "striatum_like")
+
+
 def _register_file_checkerboard(base: str) -> None:
     """Registry entries for the reference's committed fixture files
     (``lal_direct_mllib_implementation/data/<base>_{train,test}.txt``, loaded
@@ -300,9 +309,14 @@ def _agnews(cfg: DataConfig) -> DataBundle:
 
 @register_dataset("gaussian_unbalanced")
 def _gaussian_unbalanced(cfg: DataConfig) -> DataBundle:
-    """Simulated unbalanced clouds (classes/test.py:150-187)."""
+    """Simulated unbalanced clouds (classes/test.py:150-187): two random
+    Gaussian clouds, class-1 prior uniform in [10%, 90%], test set 10x the
+    pool. Each seed draws a fresh geometry — the distribution the LAL
+    regressor's Monte-Carlo training data comes from, i.e. LAL's home turf
+    (Konyushkova et al. build LAL for exactly these unbalanced problems)."""
     key = jax.random.key(cfg.seed)
-    train_x, train_y, test_x, test_y = synthetic.make_gaussian_unbalanced(key, 1000)
+    n = cfg.n_samples or 1000
+    train_x, train_y, test_x, test_y = synthetic.make_gaussian_unbalanced(key, n)
     bundle = DataBundle(
         np.asarray(train_x), np.asarray(train_y),
         np.asarray(test_x), np.asarray(test_y), "gaussian_unbalanced",

@@ -94,6 +94,31 @@ def test_gaussian_unbalanced_shapes_and_imbalance(key):
     assert 0.05 < p1 < 0.95
 
 
+def test_striatum_like_generator_contract(key):
+    """Fixed structure across keys (one dataset distribution, like striatum
+    itself), minority positives near pos_frac, labels a key-independent
+    function of x up to the 2% noise flips (the _synth split contract)."""
+    from distributed_active_learning_tpu.data.synthetic import make_striatum_like
+
+    x1, y1 = make_striatum_like(jax.random.key(1), 4000)
+    x2, y2 = make_striatum_like(jax.random.key(2), 4000)
+    assert x1.shape == (4000, 50) and y1.dtype == jnp.int32
+    assert not np.allclose(np.asarray(x1), np.asarray(x2))  # different draws
+    for y in (y1, y2):  # same boundary: minority fraction stable across keys
+        p = float(jnp.mean(y.astype(jnp.float32)))
+        assert 0.20 < p < 0.32, p
+    # noiseless labels are a pure function of x: same x -> same y
+    _, y1b = make_striatum_like(jax.random.key(1), 4000, label_noise=0.0)
+    _, y1c = make_striatum_like(jax.random.key(1), 4000, label_noise=0.0)
+    np.testing.assert_array_equal(np.asarray(y1b), np.asarray(y1c))
+    # the 2% flips only touch ~2% of labels
+    assert float(jnp.mean((y1 != y1b).astype(jnp.float32))) < 0.05
+
+    cfg = DataConfig(name="striatum_like", seed=0)
+    b = get_dataset(cfg)
+    assert b.train_x.shape == (10000, 50) and b.test_x.shape == (10000, 50)
+
+
 def test_registry_checkerboard_bundle():
     cfg = DataConfig(name="checkerboard2x2", seed=1)
     b = get_dataset(cfg)
