@@ -1,0 +1,127 @@
+"""Held-out-difficulty deep-AL runs (r5; the VERDICT item-5 fallback).
+
+This rig has NO network egress (results/REAL_BYTES_ATTEMPT.md logs the
+failed fetches), so the deep-AL arms cannot run on real CIFAR-10/AG-News
+bytes here. The r4 multiseed evidence therefore carries a documented
+selection-effect risk: the stand-in difficulty constants (image noise=2.2,
+token overlap=0.25) were chosen by sweeping on this same chip until
+strategies won (results/README.md).
+
+This protocol breaks that circularity without new data. The difficulty
+constants below were fixed by a PRE-REGISTERED RULE before any of these runs
+executed — the tuned value bracketed from both sides by a fixed step
+(images: noise 2.2 +- 0.4 -> {1.8, 2.6}; tokens: overlap 0.25 -+ 0.10 ->
+{0.15, 0.35}), with every structural knob (modes, shifts, imbalance,
+topic_frac) held at the committed registry values. No run at these settings
+was executed before the rule was written down, and no setting was discarded.
+If strategies-beat-random were an artifact of the tuned point, it should
+die at one or both brackets; tests/test_deep_holdout_artifacts.py pins the
+outcome on the committed logs.
+
+Usage: python benches/run_holdout_difficulty.py  (skip-if-exists, resumable)
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import jax
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributed_active_learning_tpu.data.synthetic import (  # noqa: E402
+    make_synthetic_images,
+    make_synthetic_tokens,
+)
+from distributed_active_learning_tpu.models.neural import (  # noqa: E402
+    NeuralLearner,
+    SmallCNN,
+)
+from distributed_active_learning_tpu.models.transformer import (  # noqa: E402
+    TransformerClassifier,
+)
+from distributed_active_learning_tpu.runtime.neural_loop import (  # noqa: E402
+    NeuralExperimentConfig,
+    run_neural_experiment,
+)
+
+OUT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "results", "deep_holdout",
+)
+
+SEEDS = (0, 1, 2, 3, 4)
+# Pre-registered brackets around the tuned points (see module docstring).
+IMAGE_NOISES = (1.8, 2.6)
+TOKEN_OVERLAPS = (0.15, 0.35)
+
+
+def _run(log_name: str, cfg: NeuralExperimentConfig, learner, x, y, ex, ey):
+    path = os.path.join(OUT, log_name)
+    if os.path.exists(path) and os.path.getsize(path) > 0:
+        print(f"skip {log_name} (exists)")
+        return
+    print(f"=== {log_name}", flush=True)
+    result = run_neural_experiment(cfg, learner, x, y, ex, ey)
+    result.save(path, fmt="reference")
+
+
+def run_images():
+    for noise in IMAGE_NOISES:
+        for seed in SEEDS:
+            # Same structure as the cifar10 registry stand-in
+            # (data/datasets.py): one draw, then split (prototypes ride the
+            # key); modes/shift/imbalance at the committed values.
+            n_train, n_test = 6000, 1200
+            x, y = make_synthetic_images(
+                jax.random.key(seed), n_train + n_test,
+                noise=noise, modes_per_class=4, max_shift=8, imbalance=0.30,
+            )
+            x, ex = np.asarray(x[:n_train]), np.asarray(x[n_train:])
+            y, ey = np.asarray(y[:n_train]), np.asarray(y[n_train:])
+            learner = NeuralLearner(
+                SmallCNN(n_classes=10), (32, 32, 3),
+                train_steps=400, mc_samples=8,
+            )
+            for arm in ("entropy", "random"):
+                cfg = NeuralExperimentConfig(
+                    strategy=f"deep.{arm}", window_size=100, n_start=20,
+                    max_rounds=20, seed=seed,
+                )
+                _run(
+                    f"cifar10_noise{noise}_deep_{arm}_window_100_seed{seed}.txt",
+                    cfg, learner, x, y, ex, ey,
+                )
+
+
+def run_tokens():
+    for overlap in TOKEN_OVERLAPS:
+        for seed in SEEDS:
+            n_train, n_test = 4000, 800
+            hard = dict(topic_frac=0.4, overlap=overlap, imbalance=0.35)
+            k_tr, k_te = jax.random.split(jax.random.key(seed))
+            x, y = make_synthetic_tokens(k_tr, n_train, **hard)
+            ex, ey = make_synthetic_tokens(k_te, n_test, **hard)
+            x, y, ex, ey = map(np.asarray, (x, y, ex, ey))
+            learner = NeuralLearner(
+                TransformerClassifier(vocab_size=4096, max_len=64, n_classes=4),
+                (64,), train_steps=400, mc_samples=8,
+            )
+            for arm in ("batchbald", "random"):
+                cfg = NeuralExperimentConfig(
+                    strategy=f"deep.{arm}", window_size=50, n_start=16,
+                    max_rounds=20, seed=seed,
+                )
+                _run(
+                    f"agnews_overlap{overlap}_deep_{arm}_window_50_seed{seed}.txt",
+                    cfg, learner, x, y, ex, ey,
+                )
+
+
+if __name__ == "__main__":
+    os.makedirs(OUT, exist_ok=True)
+    run_images()
+    run_tokens()
+    print("ALL_DONE")
