@@ -24,31 +24,82 @@ OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
                    "results", "lal_showcase")
 
 
+POOLS = (
+    # (file prefix, png name, plot title)
+    ("checkerboard2x2", "lal_vs_us_vs_rand.png",
+     "Single-point AL on the reference's checkerboard2x2 files (mean ± 1 sd)"),
+    ("gaussian_unbalanced", "lal_vs_us_vs_rand_unbalanced.png",
+     "Single-point AL on unbalanced Gaussians — LAL's home turf (mean ± 1 sd)"),
+)
+
+
 def main():
-    print("| arm | label-eff (mean curve acc) | final acc |")
+    for prefix, png, title in POOLS:
+        print(f"### {prefix}")
+        print("| arm | label-eff (mean curve acc) | final acc |")
+        print("|---|---|---|")
+        groups = []
+        for arm in ("LAL", "US", "RAND"):
+            paths = sorted(glob.glob(
+                os.path.join(OUT, f"{prefix}_dist{arm}_window_1_seed*.txt")))
+            if not paths:
+                raise SystemExit(
+                    f"no {prefix} logs for {arm} — run benches/run_lal_showcase.sh"
+                )
+            groups.append((f"dist{arm}", paths))
+            aucs, finals = [], []
+            for p in paths:
+                with open(p) as f:
+                    res = parse_reference_log(f.read())
+                accs = [r.accuracy for r in res.records]
+                aucs.append(float(np.mean(accs)))
+                finals.append(accs[-1])
+            print(f"| dist{arm} ({len(paths)} seeds) | {np.mean(aucs):.3f} ± "
+                  f"{np.std(aucs):.3f} | {np.mean(finals):.3f} ± {np.std(finals):.3f} |")
+        plot_mean_band(groups, os.path.join(OUT, png), title=title)
+        print("wrote", os.path.join(OUT, png))
+        if prefix == "gaussian_unbalanced":
+            _paired_deltas(prefix)
+
+
+def _paired_deltas(prefix):
+    """Per-seed paired AUC deltas. Each gaussian_unbalanced seed draws a
+    FRESH problem (random means/covariances, prior in [10%, 90%]), so raw
+    accuracies are not comparable across seeds — the cross-seed sd in the
+    table above is problem variance, not strategy variance. The meaningful
+    statistic is the within-seed delta on the identical pool/test draw."""
+    import re
+
+    seeds = sorted({
+        int(re.search(r"seed(\d+)", p).group(1))
+        for p in glob.glob(os.path.join(OUT, f"{prefix}_distLAL_window_1_seed*.txt"))
+    })
+    print(f"paired per-seed AUC deltas ({len(seeds)} seeds):")
+    print("| seed | LAL − RAND | LAL − US |")
     print("|---|---|---|")
-    groups = []
-    for arm in ("LAL", "US", "RAND"):
-        paths = sorted(glob.glob(
-            os.path.join(OUT, f"checkerboard2x2_dist{arm}_window_1_seed*.txt")))
-        if not paths:
-            raise SystemExit(f"no logs for {arm} — run benches/run_lal_showcase.sh")
-        groups.append((f"dist{arm}", paths))
-        aucs, finals = [], []
-        for p in paths:
+    d_rand, d_us = [], []
+    for seed in seeds:
+        auc = {}
+        for arm in ("LAL", "US", "RAND"):
+            p = os.path.join(OUT, f"{prefix}_dist{arm}_window_1_seed{seed}.txt")
+            # run_lal_showcase.sh is resumable and skips failures, so a seed
+            # can have its LAL log but not (yet) its US/RAND pair.
+            if not (os.path.exists(p) and os.path.getsize(p) > 0):
+                print(f"| {seed} | (incomplete — missing {os.path.basename(p)}) |")
+                break
             with open(p) as f:
                 res = parse_reference_log(f.read())
-            accs = [r.accuracy for r in res.records]
-            aucs.append(float(np.mean(accs)))
-            finals.append(accs[-1])
-        print(f"| dist{arm} ({len(paths)} seeds) | {np.mean(aucs):.3f} ± "
-              f"{np.std(aucs):.3f} | {np.mean(finals):.3f} ± {np.std(finals):.3f} |")
-    plot_mean_band(
-        groups, os.path.join(OUT, "lal_vs_us_vs_rand.png"),
-        title="Single-point AL on the reference's checkerboard2x2 files "
-              "(mean ± 1 sd)",
-    )
-    print("wrote", os.path.join(OUT, "lal_vs_us_vs_rand.png"))
+            auc[arm] = float(np.mean([r.accuracy for r in res.records]))
+        else:
+            d_rand.append(auc["LAL"] - auc["RAND"])
+            d_us.append(auc["LAL"] - auc["US"])
+            print(f"| {seed} | {d_rand[-1]:+.4f} | {d_us[-1]:+.4f} |")
+    if not d_rand:
+        print(f"no complete seed triples — run benches/run_lal_showcase.sh")
+        return
+    print(f"| mean | {np.mean(d_rand):+.4f} | {np.mean(d_us):+.4f} |")
+    print(f"LAL beats RAND on {sum(d > 0 for d in d_rand)}/{len(seeds)} seeds, "
+          f"US on {sum(d > 0 for d in d_us)}/{len(seeds)}")
 
 
 if __name__ == "__main__":
