@@ -85,7 +85,11 @@ def run_images():
                 SmallCNN(n_classes=10), (32, 32, 3),
                 train_steps=400, mc_samples=8,
             )
-            for arm in ("entropy", "random"):
+            # badge runs at the HARDER bracket only — the follow-up arm after
+            # entropy's noise-seeking loss there (results/README.md): does
+            # diversity-aware acquisition survive where pure uncertainty dies?
+            arms = ("entropy", "random") + (("badge",) if noise == 2.6 else ())
+            for arm in arms:
                 cfg = NeuralExperimentConfig(
                     strategy=f"deep.{arm}", window_size=100, n_start=20,
                     max_rounds=20, seed=seed,

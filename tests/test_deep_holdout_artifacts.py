@@ -24,7 +24,6 @@ import glob
 import os
 
 import numpy as np
-import pytest
 
 from distributed_active_learning_tpu.runtime.results import parse_reference_log
 
@@ -35,14 +34,17 @@ OUT = os.path.join(
 
 
 def _curves(pattern):
+    # Assert presence rather than skip: these artifacts ARE committed, and a
+    # silent skip would un-pin the very outcomes this file exists to pin.
     paths = sorted(glob.glob(os.path.join(OUT, pattern)))
-    if not paths:
-        pytest.skip(f"{pattern} not committed")
+    assert len(paths) >= 3, f"holdout logs missing: {pattern}"
     out = []
     for p in paths:
         with open(p) as f:
             res = parse_reference_log(f.read())
-        out.append([r.accuracy for r in res.records])
+        accs = [r.accuracy for r in res.records]
+        assert len(accs) == 20, f"{p}: expected 20 rounds, got {len(accs)}"
+        out.append(accs)
     return np.asarray(out)
 
 
@@ -67,6 +69,18 @@ def test_entropy_hits_noise_seeking_pathology_at_the_harder_image_bracket():
     ent = "cifar10_noise2.6_deep_entropy_window_100_seed*.txt"
     rnd = "cifar10_noise2.6_deep_random_window_100_seed*.txt"
     assert _auc(ent) < _auc(rnd) + 0.01  # no win — committed logs show a loss
+
+
+def test_badge_survives_the_noise_bracket_that_defeats_entropy():
+    """Diversity-aware acquisition is robust where pure uncertainty is not:
+    at the same noise-2.6 bracket BADGE recovers the final-accuracy win
+    (+1.7 over random, +2.7 over entropy in the committed 5-seed logs)."""
+    badge = "cifar10_noise2.6_deep_badge_window_100_seed*.txt"
+    ent = "cifar10_noise2.6_deep_entropy_window_100_seed*.txt"
+    rnd = "cifar10_noise2.6_deep_random_window_100_seed*.txt"
+    assert _final(badge) > _final(rnd) + 0.01
+    assert _final(badge) > _final(ent) + 0.01
+    assert _auc(badge) > _auc(rnd) - 0.01  # and no AUC cost for the win
 
 
 def test_batchbald_beats_random_at_both_token_brackets():

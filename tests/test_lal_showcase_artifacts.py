@@ -18,7 +18,6 @@ import os
 import re
 
 import numpy as np
-import pytest
 
 from distributed_active_learning_tpu.runtime.results import parse_reference_log
 
@@ -29,10 +28,11 @@ OUT = os.path.join(
 
 
 def _paired_aucs():
+    # Assert presence rather than skip: the logs are committed, and a silent
+    # skip would un-pin the separation claim.
     paths = sorted(glob.glob(
         os.path.join(OUT, "gaussian_unbalanced_distLAL_window_1_seed*.txt")))
-    if not paths:
-        pytest.skip("gaussian_unbalanced showcase logs not committed")
+    assert len(paths) >= 5, "gaussian_unbalanced showcase logs missing"
     seeds = sorted(int(re.search(r"seed(\d+)", p).group(1)) for p in paths)
     auc = {arm: [] for arm in ("LAL", "US", "RAND")}
     for seed in seeds:

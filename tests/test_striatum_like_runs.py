@@ -13,7 +13,6 @@ device fit, window-10/50/100 x {distUS, distRAND} — run on HELD-OUT seed 3
 (generator constants were chosen on probe seeds 0-2; results/README.md).
 """
 
-import glob
 import os
 
 import numpy as np
@@ -25,9 +24,10 @@ RESULTS = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__)
 
 
 def _accs(name):
+    # Assert presence rather than skip: a silent skip would un-pin the
+    # baseline-shape reproduction these committed logs carry.
     path = os.path.join(RESULTS, name)
-    if not glob.glob(path):
-        pytest.skip(f"{name} not committed")
+    assert os.path.exists(path), f"scale-run log missing: {name}"
     with open(path) as f:
         res = parse_reference_log(f.read())
     return np.asarray([r.accuracy for r in res.records])
