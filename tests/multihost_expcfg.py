@@ -8,7 +8,7 @@ the config from here instead to keep its own platform selection untouched.
 
 
 def experiment_cfg(mesh_data: int, checkpoint_dir=None, checkpoint_every=0,
-                   fit: str = "device"):
+                   fit: str = "device", kernel: str = "gather"):
     """The 2-process experiment configuration — the worker runs it with
     ``mesh_data=2`` on the global mesh (and per-round checkpointing, which
     exercises the collective payload gather + primary-only write), the
@@ -25,7 +25,7 @@ def experiment_cfg(mesh_data: int, checkpoint_dir=None, checkpoint_every=0,
     return ExperimentConfig(
         data=DataConfig(name="checkerboard2x2", seed=5, n_samples=256),
         forest=ForestConfig(
-            n_trees=8, max_depth=4, fit=fit, kernel="gather", fit_budget=64
+            n_trees=8, max_depth=4, fit=fit, kernel=kernel, fit_budget=64
         ),
         strategy=StrategyConfig(name="uncertainty", window_size=8),
         n_start=10,

@@ -40,14 +40,18 @@ def run_experiment_mode() -> int:
     assert len(jax.devices()) == nproc, jax.devices()  # one CPU device/process
 
     fit = sys.argv[3] if len(sys.argv) > 3 else "device"
+    kernel = sys.argv[4] if len(sys.argv) > 4 else "gather"
     # Per-round checkpointing: the payload gather is a cross-process
     # collective (host_np on the data-sharded mask), the write is
     # primary-only — both paths must hold inside the real loop. fit="host"
     # additionally exercises the collective labeled-subset gather + the
-    # same-sklearn-fit-on-every-process determinism story.
+    # same-sklearn-fit-on-every-process determinism story. kernel="pallas"
+    # runs the fused kernel per-shard under shard_map with the mesh spanning
+    # PROCESSES (interpret mode on CPU devices — the decomposition, psum,
+    # and cross-process placement are what's under test).
     res = run_experiment(
         experiment_cfg(mesh_data=nproc, checkpoint_dir=sys.argv[1],
-                       checkpoint_every=1, fit=fit)
+                       checkpoint_every=1, fit=fit, kernel=kernel)
     )
     accs = [round(r.accuracy, 6) for r in res.records]
     labeled = [r.n_labeled for r in res.records]

@@ -71,10 +71,13 @@ def test_two_process_init_collective_and_primary_checkpoint(tmp_path):
 
 @pytest.mark.slow
 @pytest.mark.parametrize(
-    "nproc,fit", [(2, "device"), (2, "host"), (4, "device")],
-    ids=["2proc-devicefit", "2proc-hostfit", "4proc-devicefit"],
+    "nproc,fit,kernel",
+    [(2, "device", "gather"), (2, "host", "gather"), (4, "device", "gather"),
+     (2, "device", "pallas")],
+    ids=["2proc-devicefit", "2proc-hostfit", "4proc-devicefit",
+         "2proc-devicefit-pallas"],
 )
-def test_multi_process_experiment_matches_single_process(tmp_path, nproc, fit):
+def test_multi_process_experiment_matches_single_process(tmp_path, nproc, fit, kernel):
     """A REAL forest AL experiment across N processes: pool rows sharded
     over the global N-device mesh, the fused round compiled by GSPMD into one
     SPMD program spanning all of them. Every worker must produce the SAME
@@ -82,7 +85,9 @@ def test_multi_process_experiment_matches_single_process(tmp_path, nproc, fit):
     mesh-is-performance-only claim, held across process boundaries, not just
     virtual devices). fit="host" runs the sklearn fit identically on every
     process from the collectively-gathered labeled subset; 4 processes check
-    the machinery is not 2-special."""
+    the machinery is not 2-special; kernel="pallas" runs the fused kernel
+    per-shard (ShardedPallasForest/shard_map) with the mesh spanning real
+    processes."""
     import json
 
     # Reference curve in THIS process (8-device virtual mesh env, mesh
@@ -92,7 +97,7 @@ def test_multi_process_experiment_matches_single_process(tmp_path, nproc, fit):
     from tests.multihost_expcfg import experiment_cfg
     from distributed_active_learning_tpu.runtime.loop import run_experiment
 
-    ref = run_experiment(experiment_cfg(mesh_data=1, fit=fit))
+    ref = run_experiment(experiment_cfg(mesh_data=1, fit=fit, kernel=kernel))
     ref_accs = [round(r.accuracy, 6) for r in ref.records]
     ref_labeled = [r.n_labeled for r in ref.records]
 
@@ -111,7 +116,7 @@ def test_multi_process_experiment_matches_single_process(tmp_path, nproc, fit):
         env.pop("PALLAS_AXON_POOL_IPS", None)
         procs.append(
             subprocess.Popen(
-                [sys.executable, _WORKER, str(tmp_path), "experiment", fit],
+                [sys.executable, _WORKER, str(tmp_path), "experiment", fit, kernel],
                 env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
                 text=True,
             )
