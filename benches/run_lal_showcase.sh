@@ -51,4 +51,21 @@ for seed in 0 1 2 3 4 5 6 7 8 9; do
   run "gaussian_unbalanced_distRAND_window_1_seed${seed}.txt" "${common[@]}" \
     --strategy random
 done
+
+# r5: rotated checkerboard (the reference's own fixture files) — the
+# geometry where batch-US's pathology is strongest, i.e. the motivating
+# example for LAL as the remedy. 5 seeds.
+for seed in 0 1 2 3 4; do
+  common=(--dataset rotated_checkerboard2x2_file --data-path "$FIX/reference_data"
+          --trees 50 --depth 8 --fit device --window 1 --rounds 200
+          --n-start 2 --seed "$seed")
+  run "rotated_checkerboard2x2_distLAL_window_1_seed${seed}.txt" "${common[@]}" \
+    --strategy lal \
+    --strategy-option "lal_data_path=$FIX/lal_simulatedunbalanced_big.txt" \
+    --strategy-option lal_trees=2000
+  run "rotated_checkerboard2x2_distUS_window_1_seed${seed}.txt" "${common[@]}" \
+    --strategy uncertainty
+  run "rotated_checkerboard2x2_distRAND_window_1_seed${seed}.txt" "${common[@]}" \
+    --strategy random
+done
 echo ALL_DONE

@@ -30,6 +30,9 @@ POOLS = (
      "Single-point AL on the reference's checkerboard2x2 files (mean ± 1 sd)"),
     ("gaussian_unbalanced", "lal_vs_us_vs_rand_unbalanced.png",
      "Single-point AL on unbalanced Gaussians — LAL's home turf (mean ± 1 sd)"),
+    ("rotated_checkerboard2x2", "lal_vs_us_vs_rand_rotated.png",
+     "Single-point AL on the reference's rotated-checkerboard files — "
+     "US's pathology geometry (mean ± 1 sd)"),
 )
 
 
@@ -58,16 +61,20 @@ def main():
                   f"{np.std(aucs):.3f} | {np.mean(finals):.3f} ± {np.std(finals):.3f} |")
         plot_mean_band(groups, os.path.join(OUT, png), title=title)
         print("wrote", os.path.join(OUT, png))
-        if prefix == "gaussian_unbalanced":
+        if prefix in ("gaussian_unbalanced", "rotated_checkerboard2x2"):
             _paired_deltas(prefix)
 
 
 def _paired_deltas(prefix):
-    """Per-seed paired AUC deltas. Each gaussian_unbalanced seed draws a
-    FRESH problem (random means/covariances, prior in [10%, 90%]), so raw
-    accuracies are not comparable across seeds — the cross-seed sd in the
-    table above is problem variance, not strategy variance. The meaningful
-    statistic is the within-seed delta on the identical pool/test draw."""
+    """Per-seed paired AUC deltas (within-seed: same pool + PRNG draw).
+
+    For gaussian_unbalanced, each seed additionally draws a FRESH problem
+    (random means/covariances, prior in [10%, 90%]), so there the cross-seed
+    sd in the table above is problem variance, not strategy variance, and
+    only the within-seed deltas are meaningful. For the fixed-file pools
+    (rotated_checkerboard2x2) every seed runs the same dataset — cross-seed
+    sd IS strategy variance there (the robustness claim) and the paired
+    table shows which seeds a strategy's pathology fires on."""
     import re
 
     seeds = sorted({
