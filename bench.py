@@ -290,10 +290,18 @@ def bench_score(args):
         flops_per_point = 2 * T * I * L + 2 * T * L
         achieved = scores_per_sec * flops_per_point
         peak, chip = _peak_flops()
+        # achieved_tflops is AGGREGATE mesh throughput; MFU divides by the
+        # mesh's total peak (n_mesh chips), not one chip's — a 2x2 mesh at
+        # per-chip-peak MFU would otherwise read as 400% (advisor r5).
+        n_mesh = (
+            args.mesh_data * args.mesh_model if getattr(args, "mesh_data", 0) else 1
+        )
         result["achieved_tflops"] = round(achieved / 1e12, 2)
         result["chip"] = chip
+        if n_mesh > 1:
+            result["mesh_devices"] = n_mesh
         if peak:
-            result["mfu"] = round(achieved / peak, 4)
+            result["mfu"] = round(achieved / (peak * n_mesh), 4)
     return result
 
 
@@ -452,7 +460,7 @@ def bench_round(args):
     host_sec = _median_time(run_host, max(args.iters // 2, 1))
 
     spark_round_sec = args.pool * args.trees / SPARK_TREE_POINTS_PER_SEC
-    return {
+    result = {
         "round_seconds": round(device_sec, 4),
         "round_device_seconds": round(round_dev_sec, 4),
         "round_time_method": round_dev_method,
@@ -462,6 +470,94 @@ def bench_round(args):
         "vs_baseline": round(spark_round_sec / device_sec, 1),
         "vs_baseline_device": round(spark_round_sec / round_dev_sec, 1),
         "spark_round_seconds_derived": round(spark_round_sec, 1),
+    }
+    result.update(_bench_scan_fusion(args, pool, pool_y, mask0, binned))
+    return result
+
+
+def _bench_scan_fusion(args, pool, pool_y, mask0, binned):
+    """Multi-round driver cost, per-round vs scan-fused (the PR-2 tentpole).
+
+    Drives the PRODUCTION chunk program (``runtime.loop.make_chunk_fn``) for
+    K = ``--rounds-per-launch`` rounds in ONE launch + one host touchdown,
+    against the per-round driver's 3-sync sequence (fit / round / accuracy
+    fetch) over the same K rounds from the same state. Both numbers are wall
+    seconds per round and land in the JSON together, so one invocation
+    records the fused win AND its baseline; on the tunnel rig (~90-100 ms
+    per-program sync) the per-round driver pays ~3 syncs/round and the scan
+    path ~3/K.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_active_learning_tpu.config import (
+        ExperimentConfig,
+        ForestConfig,
+        StrategyConfig,
+    )
+    from distributed_active_learning_tpu.runtime import state as state_lib
+    from distributed_active_learning_tpu.runtime.loop import (
+        _accuracy,
+        make_chunk_fn,
+        make_device_fit,
+        make_round_fn,
+    )
+    from distributed_active_learning_tpu.strategies import StrategyAux, get_strategy
+
+    K = max(int(getattr(args, "rounds_per_launch", 1) or 1), 1)
+    window = args.window
+    ecfg = ExperimentConfig(
+        forest=ForestConfig(
+            n_trees=args.trees, max_depth=args.depth,
+            kernel=args.kernel, fit="device",
+            # Labels grow by K windows inside one measured launch.
+            fit_budget=1 << (args.train_rows + K * window).bit_length(),
+        ),
+        strategy=StrategyConfig(name="uncertainty", window_size=window),
+    )
+    # `binned` comes from bench_round — same pool, same max_bins default —
+    # so the full-pool binning pass is not paid a second time here.
+    state0 = state_lib.init_pool_state(pool, pool_y, jax.random.key(0))
+    state0 = state0.replace(labeled_mask=jnp.asarray(mask0))
+    device_fit = make_device_fit(
+        ecfg, binned.edges, ecfg.forest.fit_budget
+    )
+    strategy = get_strategy(ecfg.strategy)
+    round_fn = make_round_fn(strategy, window)
+    aux = StrategyAux(seed_mask=state0.labeled_mask)
+    fit_key = jax.random.key(7)
+    # Small held-out set so the chunk includes the accuracy eval the real
+    # driver performs (its cost is part of the per-round sync story).
+    tx, ty = state0.x[:2048], state0.oracle_y[:2048]
+    end_round = np.iinfo(np.int32).max
+
+    chunk_fn = make_chunk_fn(
+        strategy, window, K, device_fit, label_cap=state0.n_valid
+    )
+
+    def run_chunked():
+        _, ys = chunk_fn(binned.codes, state0, aux, fit_key, tx, ty, end_round)
+        np.asarray(ys[4])  # the driver's one touchdown: fetch the stacked ys
+
+    def run_per_round():
+        st = state0
+        for r in range(1, K + 1):
+            forest = device_fit(binned.codes, st, jax.random.fold_in(fit_key, r))
+            jax.block_until_ready(forest)
+            st, picked, _ = round_fn(forest, st, aux)
+            jax.block_until_ready(picked)
+            float(_accuracy(forest, tx, ty))
+
+    run_chunked()   # compile
+    run_per_round() # compile
+    reps = max(min(args.iters, 5), 2)
+    chunk_sec = _median_time(run_chunked, reps) / K
+    per_round_sec = _median_time(run_per_round, reps) / K
+    return {
+        "rounds_per_launch": K,
+        "scan_seconds_per_round": round(chunk_sec, 4),
+        "per_round_driver_seconds_per_round": round(per_round_sec, 4),
+        "scan_fusion_speedup": round(per_round_sec / chunk_sec, 2),
     }
 
 
@@ -708,43 +804,83 @@ def _run_mode(args) -> dict:
             "vs_baseline": r["vs_baseline"],
             **{k: v for k, v in r.items() if k not in ("lal_query_seconds", "vs_baseline")},
         }
-    s = bench_score(args)
-    d = bench_density(args)
-    rd = bench_round(args)
-    ll = bench_lal(args)
-    nn = bench_neural(args)
-    return {
-        "metric": "acquisition_scores_per_sec",
-        "value": s["value"],
-        "unit": f"scores/s device throughput ({args.pool}x{args.features} pool, {args.trees} trees, depth {args.depth}, {s['kernel']} kernel)",
-        "vs_baseline": s["vs_baseline"],
-        "vs_baseline_wall": s["vs_baseline_wall"],
-        "mfu": s.get("mfu"),
-        "achieved_tflops": s.get("achieved_tflops"),
-        "chip": s.get("chip"),
-        "device_time_method": s["device_time_method"],
-        "wall_seconds_per_query": s["wall_seconds_per_query"],
-        "wall_scores_per_sec": s["wall_scores_per_sec"],
-        "density_scores_per_sec": d["density_scores_per_sec"],
-        "density_time_method": d["density_time_method"],
-        "round_seconds": rd["round_seconds"],
-        "round_device_seconds": rd["round_device_seconds"],
-        "round_time_method": rd["round_time_method"],
-        "round_fit_seconds": rd["round_fit_seconds"],
-        "round_score_seconds": rd["round_score_seconds"],
-        "round_seconds_host_fit": rd["round_seconds_host_fit"],
-        "round_vs_spark_derived": rd["vs_baseline"],
-        "round_vs_spark_derived_device": rd["vs_baseline_device"],
-        "lal_query_seconds": ll["lal_query_seconds"],
-        "lal_query_device_seconds": ll["lal_query_device_seconds"],
-        "lal_time_method": ll["lal_time_method"],
-        "lal_query_vs_spark": ll["vs_baseline"],
-        "lal_query_vs_spark_device": ll["vs_baseline_device"],
-        "cnn_round_seconds": nn["cnn_round_seconds"],
-        "cnn_time_method": nn["cnn_time_method"],
-        "transformer_batchbald_round_seconds": nn["transformer_batchbald_round_seconds"],
-        "transformer_time_method": nn["transformer_time_method"],
-    }
+    # --mode all: run the five benches sequentially, each gated on the
+    # --deadline budget. BENCH_r05 recorded `rc: 124, parsed: null` because a
+    # timeout killed the process before the single end-of-run JSON print —
+    # now exceeding the deadline SKIPS the remaining modes and the JSON (with
+    # a modes_skipped key) always lands for whatever completed.
+    t0 = getattr(args, "_start_time", None) or time.perf_counter()
+    deadline = getattr(args, "deadline", None)
+    skipped = []
+
+    def want(name):
+        if deadline and time.perf_counter() - t0 > deadline:
+            skipped.append(name)
+            return False
+        return True
+
+    out = {}
+    if want("score"):
+        s = bench_score(args)
+        out.update({
+            "metric": "acquisition_scores_per_sec",
+            "value": s["value"],
+            "unit": f"scores/s device throughput ({args.pool}x{args.features} pool, {args.trees} trees, depth {args.depth}, {s['kernel']} kernel)",
+            "vs_baseline": s["vs_baseline"],
+            "vs_baseline_wall": s["vs_baseline_wall"],
+            "mfu": s.get("mfu"),
+            "achieved_tflops": s.get("achieved_tflops"),
+            "chip": s.get("chip"),
+            "mesh_devices": s.get("mesh_devices"),
+            "device_time_method": s["device_time_method"],
+            "wall_seconds_per_query": s["wall_seconds_per_query"],
+            "wall_scores_per_sec": s["wall_scores_per_sec"],
+        })
+    if want("density"):
+        d = bench_density(args)
+        out.update({
+            "density_scores_per_sec": d["density_scores_per_sec"],
+            "density_time_method": d["density_time_method"],
+        })
+    if want("round"):
+        rd = bench_round(args)
+        out.update({
+            "round_seconds": rd["round_seconds"],
+            "round_device_seconds": rd["round_device_seconds"],
+            "round_time_method": rd["round_time_method"],
+            "round_fit_seconds": rd["round_fit_seconds"],
+            "round_score_seconds": rd["round_score_seconds"],
+            "round_seconds_host_fit": rd["round_seconds_host_fit"],
+            "round_vs_spark_derived": rd["vs_baseline"],
+            "round_vs_spark_derived_device": rd["vs_baseline_device"],
+            "rounds_per_launch": rd["rounds_per_launch"],
+            "scan_seconds_per_round": rd["scan_seconds_per_round"],
+            "per_round_driver_seconds_per_round": rd["per_round_driver_seconds_per_round"],
+            "scan_fusion_speedup": rd["scan_fusion_speedup"],
+        })
+    if want("lal"):
+        ll = bench_lal(args)
+        out.update({
+            "lal_query_seconds": ll["lal_query_seconds"],
+            "lal_query_device_seconds": ll["lal_query_device_seconds"],
+            "lal_time_method": ll["lal_time_method"],
+            "lal_query_vs_spark": ll["vs_baseline"],
+            "lal_query_vs_spark_device": ll["vs_baseline_device"],
+        })
+    if want("neural"):
+        nn = bench_neural(args)
+        out.update({
+            "cnn_round_seconds": nn["cnn_round_seconds"],
+            "cnn_time_method": nn["cnn_time_method"],
+            "transformer_batchbald_round_seconds": nn["transformer_batchbald_round_seconds"],
+            "transformer_time_method": nn["transformer_time_method"],
+        })
+    if "metric" not in out:
+        out["metric"] = "none_completed_before_deadline"
+        out["value"] = None
+    if skipped:
+        out["modes_skipped"] = skipped
+    return out
 
 
 def run_with_health(args) -> dict:
@@ -769,7 +905,13 @@ def run_with_health(args) -> dict:
 
     payload, health, took = attempt()
     if health["degraded_rig"]:
-        if took > 360.0:
+        t0 = getattr(args, "_start_time", None)
+        deadline = getattr(args, "deadline", None)
+        if deadline and t0 and time.perf_counter() - t0 > deadline:
+            # Past the caller's deadline: rerunning would risk losing the
+            # artifact entirely (the exact failure --deadline exists to stop).
+            health["rig_health_retry_skipped"] = "deadline exceeded"
+        elif took > 360.0:
             # A degraded session also runs the suite slowly; doubling an
             # already-slow run risks the caller's timeout killing the whole
             # artifact (then the round has NO bench record at all — worse
@@ -817,7 +959,23 @@ def main():
         help="forest evaluation kernel (pallas = fused VMEM-resident kernel, "
         "the fastest scoring path; gemm = two-batched-GEMM path-matrix form)",
     )
+    ap.add_argument(
+        "--rounds-per-launch", type=int, default=8,
+        help="round mode: AL rounds fused into one lax.scan launch for the "
+        "scan-fusion comparison (runtime.loop.make_chunk_fn); 1 measures "
+        "only the per-round driver against itself",
+    )
+    ap.add_argument(
+        "--deadline", type=float, default=None,
+        help="wall-seconds budget for --mode all: once exceeded, remaining "
+        "modes are skipped (recorded under modes_skipped) and the JSON for "
+        "completed modes still prints — so an outer `timeout` never leaves "
+        "the round with no bench artifact at all",
+    )
     args = ap.parse_args()
+    # Anchor for --deadline: counts JIT compiles and the rig-health probe,
+    # not just the bench bodies, since the outer timeout counts them too.
+    args._start_time = time.perf_counter()
     print(json.dumps(run_with_health(args)))
 
 

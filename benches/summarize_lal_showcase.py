@@ -85,14 +85,18 @@ def _paired_deltas(prefix):
     print("| seed | LAL − RAND | LAL − US |")
     print("|---|---|---|")
     d_rand, d_us = [], []
+    incomplete = []  # (seed, missing file) notes, emitted AFTER the table
     for seed in seeds:
         auc = {}
         for arm in ("LAL", "US", "RAND"):
             p = os.path.join(OUT, f"{prefix}_dist{arm}_window_1_seed{seed}.txt")
             # run_lal_showcase.sh is resumable and skips failures, so a seed
-            # can have its LAL log but not (yet) its US/RAND pair.
+            # can have its LAL log but not (yet) its US/RAND pair. The row
+            # still needs all three cells or the markdown table breaks; the
+            # human-readable note moves below the table.
             if not (os.path.exists(p) and os.path.getsize(p) > 0):
-                print(f"| {seed} | (incomplete — missing {os.path.basename(p)}) |")
+                print(f"| {seed} | — | — |")
+                incomplete.append((seed, os.path.basename(p)))
                 break
             with open(p) as f:
                 res = parse_reference_log(f.read())
@@ -102,9 +106,13 @@ def _paired_deltas(prefix):
             d_us.append(auc["LAL"] - auc["US"])
             print(f"| {seed} | {d_rand[-1]:+.4f} | {d_us[-1]:+.4f} |")
     if not d_rand:
+        for seed, missing in incomplete:
+            print(f"seed {seed} incomplete — missing {missing}")
         print(f"no complete seed triples — run benches/run_lal_showcase.sh")
         return
     print(f"| mean | {np.mean(d_rand):+.4f} | {np.mean(d_us):+.4f} |")
+    for seed, missing in incomplete:
+        print(f"seed {seed} incomplete — missing {missing}")
     print(f"LAL beats RAND on {sum(d > 0 for d in d_rand)}/{len(seeds)} seeds, "
           f"US on {sum(d > 0 for d in d_us)}/{len(seeds)}")
 

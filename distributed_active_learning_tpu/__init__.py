@@ -23,4 +23,17 @@ Package layout:
 
 __version__ = "0.1.0"
 
-from distributed_active_learning_tpu import config  # noqa: F401
+import jax as _jax
+
+# Sharding-invariant PRNG, non-negotiable for a distributed system: with the
+# legacy (non-partitionable) threefry lowering, jax.random draws change VALUE
+# with the surrounding program's GSPMD partitioning — observed concretely as
+# the device trainer's bootstrap weights differing between the per-round
+# program and the scan-fused chunk program on a >1-device mesh, silently
+# breaking chunked == per-round parity (runtime/loop.py make_chunk_fn).
+# Partitionable threefry guarantees draws depend only on (key, position),
+# never placement; it is the default from JAX 0.5 onward — this pins the
+# same semantics on the 0.4.x the rig ships.
+_jax.config.update("jax_threefry_partitionable", True)
+
+from distributed_active_learning_tpu import config  # noqa: F401, E402

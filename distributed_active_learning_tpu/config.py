@@ -130,6 +130,15 @@ class ExperimentConfig:
     # Stop when this many points are labeled, or pool exhausted (None = exhaust).
     label_budget: Optional[int] = None
     max_rounds: Optional[int] = None
+    # Rounds fused into ONE jitted lax.scan launch when the whole round is
+    # device-resident (ForestConfig.fit == "device"): the host touches down
+    # only every rounds_per_launch rounds to append records/log/checkpoint,
+    # cutting per-round host syncs from 3 to <= 3/K on launch-latency-bound
+    # rigs. Purely a performance knob — stopping stays exact (rounds past the
+    # label budget are in-scan no-ops) and results are identical to the
+    # per-round driver. Silently falls back to the per-round path for host
+    # fit or when a Debugger wants per-phase timings (runtime/loop.py).
+    rounds_per_launch: int = 1
     seed: int = 0
     # Observability
     log_every: int = 1

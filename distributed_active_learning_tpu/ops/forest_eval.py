@@ -9,7 +9,14 @@ Three device representations of the same fitted forest exist:
 - :class:`~distributed_active_learning_tpu.ops.trees_pallas.PallasForest` —
   the same path-matrix data evaluated by one fused Pallas kernel that keeps
   the compare/hit intermediates in VMEM (lifting the HBM-bandwidth cap of the
-  two-GEMM form).
+  two-GEMM form);
+- :class:`~distributed_active_learning_tpu.ops.trees_pallas.ShardedPallasForest`
+  — the mesh-aware twin of ``PallasForest``: carries a ``jax.sharding.Mesh``
+  as static metadata and evaluates the fused kernel PER SHARD under
+  ``shard_map`` (pool rows over ``data``, trees over ``model``), since
+  ``pallas_call`` has no GSPMD partitioning rule. Built by
+  ``trees_pallas.attach_mesh``; multi-device rounds use it so the flagship
+  kernel survives sharding instead of falling back to the two-GEMM form.
 
 Strategies and the round function call through these dispatchers so the kernel
 choice is a config knob (``ForestConfig.kernel``), not a code path: the pytree
@@ -28,7 +35,12 @@ import jax.numpy as jnp
 
 from distributed_active_learning_tpu.ops import trees, trees_gemm, trees_pallas
 
-Forest = Union[trees.PackedForest, trees_gemm.GemmForest, trees_pallas.PallasForest]
+Forest = Union[
+    trees.PackedForest,
+    trees_gemm.GemmForest,
+    trees_pallas.PallasForest,
+    trees_pallas.ShardedPallasForest,
+]
 
 # Deepest forest converted to path-matrix form; beyond this the O(4^depth)
 # path tensor outgrows its MXU advantage (and, eventually, host memory).

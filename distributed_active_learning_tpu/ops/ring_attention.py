@@ -107,7 +107,9 @@ def ring_attention(
     kernel = functools.partial(
         _ring_attention_sharded, axis_name=axis_name, causal=causal, scale=scale
     )
-    return jax.shard_map(
+    from distributed_active_learning_tpu.utils.compat import shard_map
+
+    return shard_map(
         kernel, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
     )(q, k, v)
 

@@ -270,8 +270,10 @@ def _predict_leaves_sharded(f: ShardedPallasForest, x: jnp.ndarray) -> jnp.ndarr
     x = _pad_to(x, 0, f.mesh.shape[mesh_lib.AXIS_DATA])
     gf_specs = mesh_lib.forest_tree_specs(f.gf)
 
+    from distributed_active_learning_tpu.utils.compat import shard_map
+
     @functools.partial(
-        jax.shard_map,
+        shard_map,
         mesh=f.mesh,
         in_specs=(gf_specs, P(mesh_lib.AXIS_DATA, None)),
         out_specs=P(mesh_lib.AXIS_DATA, mesh_lib.AXIS_MODEL),

@@ -51,6 +51,7 @@ local TPU), so further kernel work is not the lever here.
 from __future__ import annotations
 
 import functools
+import math
 from typing import Optional, Tuple
 
 import jax
@@ -97,6 +98,30 @@ def code_features(x: jnp.ndarray, edges: jnp.ndarray) -> jnp.ndarray:
     return jax.vmap(
         lambda e, col: jnp.searchsorted(e, col, side="left"), in_axes=(0, 1), out_axes=1
     )(edges, x).astype(jnp.int32)
+
+
+# Poisson(1) CDF, truncated where it saturates f32 (P[w > 12] ~ 1e-13).
+_POISSON1_CDF = np.cumsum(
+    [np.exp(-1.0) / math.factorial(k) for k in range(13)]
+).astype(np.float32)
+
+
+def poisson1(key: jax.Array, shape) -> jnp.ndarray:
+    """Poisson(1) draws via inverse-CDF on one uniform per element.
+
+    NOT ``jax.random.poisson``: its rejection-sampling loop compiles to
+    different draw sequences depending on the surrounding program's GSPMD
+    partitioning (observed on the virtual CPU mesh: same key, different
+    bootstrap weights once the fit is fused into the chunked scan driver,
+    silently breaking chunked == per-round parity). ``uniform`` is an
+    elementwise counter-mode draw, stable under any partitioning, and the
+    inverse-CDF lookup is elementwise too — so every compilation context
+    agrees bit-for-bit.
+    """
+    u = jax.random.uniform(key, shape)
+    return jnp.searchsorted(jnp.asarray(_POISSON1_CDF), u, side="right").astype(
+        jnp.int32
+    )
 
 
 def _gini_gain(
@@ -178,7 +203,10 @@ def fit_forest_device(
         # Poisson(1) bootstrap weights, zeroed outside the labeled window.
         # bf16 end-to-end: weights are small integers (exact in bf16) and the
         # per-level one-hot build below is memory-bound.
-        w = jax.random.poisson(k_boot, 1.0, (Tc, m)).astype(jnp.bfloat16)
+        # poisson1, not jax.random.poisson: the latter's rejection loop is
+        # not GSPMD-partitioning-stable (see poisson1 docstring), which broke
+        # chunked-scan vs per-round fit parity on >1-device meshes.
+        w = poisson1(k_boot, (Tc, m)).astype(jnp.bfloat16)
         w = w * weights[None, :].astype(jnp.bfloat16)
 
         node = jnp.zeros((Tc, m), dtype=jnp.int32)  # level-local node index

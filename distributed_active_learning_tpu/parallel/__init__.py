@@ -21,6 +21,7 @@ from distributed_active_learning_tpu.parallel.mesh import (
     replicated_spec,
     shard_pool_state,
     shard_forest,
+    constrain_forest,
 )
 from distributed_active_learning_tpu.parallel.kernels import (
     sharded_votes,

@@ -30,6 +30,7 @@ from distributed_active_learning_tpu.parallel import mesh as mesh_lib
 from distributed_active_learning_tpu.parallel.collectives import vector_accumulate
 from distributed_active_learning_tpu.runtime.state import PoolState
 from distributed_active_learning_tpu.strategies.base import Strategy, StrategyAux
+from distributed_active_learning_tpu.utils.compat import shard_map
 
 
 def sharded_votes(mesh: Mesh):
@@ -50,11 +51,37 @@ def sharded_votes(mesh: Mesh):
     """
     from distributed_active_learning_tpu.ops import forest_eval
 
+    def _local_eval_form(forest):
+        """Unwrap mesh-aware pallas wrappers to their plain per-shard form.
+
+        A :class:`~ops.trees_pallas.ShardedPallasForest` evaluates by
+        shard_mapping ITSELF over its attached mesh — inside this kernel's
+        shard_map body that would nest a second shard_map over already-local
+        shapes (undefined axis context, and at best a second round of
+        collectives). The wrapper exists to make plain ``jit`` calls shard;
+        here the sharding is explicit, so evaluation must use the plain
+        :class:`PallasForest` on the local tree shard.
+        """
+        from distributed_active_learning_tpu.ops.trees_multi import MultiForest
+        from distributed_active_learning_tpu.ops.trees_pallas import (
+            PallasForest,
+            ShardedPallasForest,
+        )
+
+        if isinstance(forest, MultiForest):
+            return MultiForest(
+                planes=tuple(_local_eval_form(p) for p in forest.planes)
+            )
+        if isinstance(forest, ShardedPallasForest):
+            return PallasForest(gf=forest.gf)
+        return forest
+
     def votes_fn(forest, x: jnp.ndarray) -> jnp.ndarray:
+        forest = _local_eval_form(forest)
         tree_specs = mesh_lib.forest_tree_specs(forest)
 
         @functools.partial(
-            jax.shard_map,
+            shard_map,
             mesh=mesh,
             in_specs=(tree_specs, P(mesh_lib.AXIS_DATA, None)),
             out_specs=P(mesh_lib.AXIS_DATA),
@@ -85,7 +112,7 @@ def sharded_similarity_mass(mesh: Mesh):
     """
 
     @functools.partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(P(mesh_lib.AXIS_DATA, None), P(mesh_lib.AXIS_DATA)),
         out_specs=P(mesh_lib.AXIS_DATA),

@@ -142,3 +142,22 @@ def shard_forest(forest, mesh: Mesh):
         forest,
         specs,
     )
+
+
+def constrain_forest(forest, mesh: Mesh):
+    """Traced twin of :func:`shard_forest` for forests built INSIDE a jitted
+    program (the chunked driver's in-scan device fit, runtime/loop.py
+    ``make_chunk_fn``): ``device_put`` is a host-side placement, so inside a
+    ``lax.scan`` body the model-axis layout is asserted with
+    ``with_sharding_constraint`` instead — same specs, same resulting
+    placement, but expressed as a constraint GSPMD propagates through the
+    scan. Works on tracers and concrete arrays alike.
+    """
+    specs = forest_tree_specs(forest)
+    return jax.tree.map(
+        lambda leaf, spec: jax.lax.with_sharding_constraint(
+            leaf, NamedSharding(mesh, spec)
+        ),
+        forest,
+        specs,
+    )

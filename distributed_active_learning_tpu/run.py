@@ -62,6 +62,12 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--n-start", type=int, default=10)
     ap.add_argument("--rounds", type=int, default=None)
     ap.add_argument("--budget", type=int, default=None, help="stop at N labeled")
+    ap.add_argument(
+        "--rounds-per-launch", type=int, default=1,
+        help="with --fit device: fuse this many AL rounds into one jitted "
+        "lax.scan launch (host touches down only at chunk boundaries; "
+        "results identical, stopping exact). 1 = per-round driver",
+    )
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--out", default=None, help="write reference-format results log")
     ap.add_argument("--plot", default=None, help="save accuracy/time curves as PNG")
@@ -158,7 +164,13 @@ def main(argv=None) -> int:
     from distributed_active_learning_tpu.runtime.debugger import Debugger
     from distributed_active_learning_tpu.runtime.loop import run_experiment
 
-    dbg = Debugger(enabled=not args.quiet)
+    # --rounds-per-launch > 1 is an explicit request for scan fusion: drop the
+    # per-phase wall splits (unattributable inside one fused launch) but keep
+    # the iteration logs. Default keeps full phase detail.
+    dbg = Debugger(
+        enabled=not args.quiet,
+        phase_detail=None if getattr(args, "rounds_per_launch", 1) <= 1 else False,
+    )
     # Both loops gate persistence on dir AND interval; half a request would be
     # silently ignored, dropping the user's crash-resume protection.
     if bool(args.checkpoint_dir) != bool(args.checkpoint_every):
@@ -221,6 +233,7 @@ def main(argv=None) -> int:
         n_start=args.n_start,
         max_rounds=args.rounds,
         label_budget=args.budget,
+        rounds_per_launch=args.rounds_per_launch,
         seed=args.seed,
         results_path=None,  # _emit handles --out for both loop kinds
         checkpoint_dir=args.checkpoint_dir,

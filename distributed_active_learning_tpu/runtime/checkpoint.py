@@ -15,6 +15,16 @@ records as JSON lines. Masks are stored over *real* pool rows only — mesh
 padding is a placement detail, so a checkpoint written under one ``--mesh-data``
 resumes under any other (the mesh is deliberately absent from fingerprints).
 
+Chunk-boundary saves: the scan-fused driver (``runtime/loop.py``
+``make_chunk_fn``) only touches the host every ``rounds_per_launch`` rounds,
+so with ``checkpoint_every = N`` it writes at the first chunk boundary at or
+after each multiple of N — step numbers need not land on the multiples
+themselves. Nothing else changes: the payload is the same
+``alstate_<round>.npz``, the fingerprint excludes ``rounds_per_launch`` (like
+the mesh, it is performance-only — chunked and per-round drivers produce
+bit-identical state, tests/test_chunked_driver.py), so a checkpoint written
+by either driver resumes under the other, at any chunk size.
+
 Bit-identical resume holds for same-mesh resumes on both loops, and for
 cross-mesh resumes of the *forest* loop (the sharded round matches the
 unsharded one bit-for-bit, tests/test_parallel.py). Cross-mesh resumes of the

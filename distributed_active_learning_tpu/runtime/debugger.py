@@ -21,9 +21,16 @@ from typing import Dict, List, Optional, Tuple
 class Debugger:
     """Phase timer with the reference's TIMESTAMP semantics + structured records."""
 
-    def __init__(self, enabled: bool = True, printer=print):
+    def __init__(self, enabled: bool = True, printer=print, phase_detail=None):
         self.enabled = enabled
         self.printer = printer
+        # Whether per-phase (train/score/eval) wall splits are wanted. An
+        # enabled debugger implies yes by default — and the chunked driver
+        # (runtime/loop.py make_chunk_fn) cannot attribute phases inside one
+        # fused scan launch, so it falls back to the per-round path when this
+        # is set. Pass phase_detail=False to keep prints/logs while opting
+        # into scan fusion (run.py does this for --rounds-per-launch > 1).
+        self.phase_detail = enabled if phase_detail is None else phase_detail
         self.records: List[Tuple[str, float]] = []
         self._start = time.perf_counter()
         self._last = self._start

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import time
 from typing import Optional, Tuple
 
 import jax
@@ -162,6 +163,88 @@ def make_device_fit(
     return fit
 
 
+def make_chunk_fn(
+    strategy: Strategy,
+    window_size: int,
+    chunk_size: int,
+    fit_fn,
+    label_cap: int,
+    mesh=None,
+    wrap_pallas: bool = False,
+):
+    """Fuse ``chunk_size`` AL rounds into ONE jitted ``lax.scan`` program.
+
+    The per-round driver pays three host round-trips per round (fit, round,
+    accuracy) — ~90-100 ms of pure launch latency each on the tunnel rig
+    (bench.py ``_device_time_per_call``), the dominant cost of small/medium
+    pools. When the fit itself is on device (``ForestConfig.fit="device"``)
+    the whole round is pure XLA, so K rounds scan into one launch: the carry
+    is the :class:`~runtime.state.PoolState` (mask + PRNG key + round
+    counter), and per-round outputs come back as stacked scan ys.
+
+    Stopping stays EXACT, not chunk-quantized: each scan step computes
+    ``active = (labeled < label_cap) & (round < end_round)`` and an inactive
+    step is a masked no-op — the carried state (mask, key, round) passes
+    through untouched via :func:`~runtime.state.select_state`, so a chunk may
+    overrun the stopping point and the final state still matches the
+    per-round driver bit-for-bit. Inactive steps still compute a (discarded)
+    fit/score — wasted work bounded by one chunk tail, bought for launch
+    latency on every earlier chunk.
+
+    Under a mesh, ``constrain_forest`` asserts the freshly fitted forest's
+    model-axis placement inside the scan (``shard_forest``'s ``device_put``
+    is host-side and cannot run in traced code), and ``wrap_pallas`` rewraps
+    it as a :class:`~ops.trees_pallas.ShardedPallasForest` so the fused
+    kernel shard_maps per (data, model) block exactly like the per-round
+    path.
+
+    Returns ``chunk_fn(codes, state, aux, fit_key, test_x, test_y,
+    end_round) -> (new_state, (rounds, n_labeled, accuracy, picked,
+    active))`` where each y is stacked ``[chunk_size, ...]``; ``n_labeled``
+    is the pre-reveal count (what the evaluated forest was trained on, the
+    reference's print ordering) and ``end_round`` rides as a traced scalar so
+    ``max_rounds`` changes never recompile.
+    """
+    round_fn = make_round_fn(strategy, window_size)
+
+    @jax.jit
+    def chunk_fn(
+        codes: jnp.ndarray,
+        state: state_lib.PoolState,
+        aux: StrategyAux,
+        fit_key: jax.Array,
+        test_x: jnp.ndarray,
+        test_y: jnp.ndarray,
+        end_round: jnp.ndarray,
+    ):
+        def body(carry: state_lib.PoolState, _):
+            n_labeled = state_lib.labeled_count(carry)
+            active = (n_labeled < label_cap) & (carry.round < end_round)
+            forest = fit_fn(
+                codes, carry, jax.random.fold_in(fit_key, carry.round + 1)
+            )
+            if mesh is not None:
+                from distributed_active_learning_tpu.parallel import (
+                    constrain_forest,
+                )
+
+                forest = constrain_forest(forest, mesh)
+                if wrap_pallas:
+                    from distributed_active_learning_tpu.ops.trees_pallas import (
+                        attach_mesh,
+                    )
+
+                    forest = attach_mesh(forest, mesh)
+            new_state, picked, _ = round_fn(forest, carry, aux)
+            acc = _accuracy(forest, test_x, test_y)
+            out = state_lib.select_state(active, new_state, carry)
+            return out, (carry.round + 1, n_labeled, acc, picked, active)
+
+        return jax.lax.scan(body, state, None, length=chunk_size)
+
+    return chunk_fn
+
+
 def build_aux(cfg: ExperimentConfig, state: state_lib.PoolState) -> StrategyAux:
     """Assemble strategy aux inputs (LAL regressor, seed mask) from config."""
     lal_forest = None
@@ -302,6 +385,122 @@ def run_experiment(
 
     n_pool = state.n_valid  # real rows only; padding is never selectable
     round_idx = start_round
+
+    # Chunked (scan-fused) driver: only when the whole round is device-
+    # resident. Host fit needs a host round-trip per round by construction,
+    # and a Debugger asking for per-phase (train/score/eval) wall splits
+    # needs per-program syncs a fused scan cannot attribute — both fall back
+    # to the per-round path below. (Debugger.phase_detail defaults to its
+    # enabled flag; pass phase_detail=False to keep logs AND fuse.)
+    use_chunked = (
+        cfg.rounds_per_launch > 1
+        and device_fit is not None
+        and not getattr(dbg, "phase_detail", dbg.enabled)
+    )
+    if use_chunked:
+        K, window = cfg.rounds_per_launch, cfg.strategy.window_size
+        label_cap = n_pool if cfg.label_budget is None else min(cfg.label_budget, n_pool)
+        chunk_fn = make_chunk_fn(
+            strategy, window, K, device_fit, label_cap,
+            mesh=mesh,
+            wrap_pallas=(mesh is not None and cfg.forest.kernel == "pallas"),
+        )
+        end_round = (
+            start_round + cfg.max_rounds
+            if cfg.max_rounds is not None
+            else int(np.iinfo(np.int32).max)
+        )
+        # One sync at loop entry; afterwards the labeled count is tracked from
+        # chunk outputs (upper-bounded by +window past the last pre-reveal
+        # count — exact enough for the stop test, see break conditions below).
+        n_known = int(state_lib.labeled_count(state))
+        ckpt_mark = start_round
+        while True:
+            if n_known >= label_cap:
+                break
+            if cfg.max_rounds is not None and round_idx - start_round >= cfg.max_rounds:
+                break
+            # Projected upper bound on any ACTIVE in-chunk fit's labeled rows:
+            # raised here (pre-launch) instead of mid-round — an in-scan fit
+            # cannot raise, and letting gather_fit_window silently truncate
+            # would corrupt the curve. Only rounds that can still be active
+            # count (inactive tail fits are computed but discarded); slightly
+            # more conservative than the per-round check (projects a whole
+            # chunk ahead).
+            rounds_left = (
+                K
+                if cfg.max_rounds is None
+                else min(K, cfg.max_rounds - (round_idx - start_round))
+            )
+            # Pre-reveal counts advance on the n_known + j*window lattice, and
+            # an active round needs its count < label_cap — so the largest
+            # reachable ACTIVE fit size is the last lattice point under the
+            # cap, not label_cap - 1 (which may be unreachable and would
+            # falsely reject configs the per-round driver completes).
+            j_cap = -(-(label_cap - n_known) // window) - 1  # ceil-div - 1
+            projected = n_known + min(rounds_left - 1, j_cap) * window
+            if projected > fit_budget:
+                raise ValueError(
+                    f"up to {projected} labeled rows would exceed the device "
+                    f"fit window ({fit_budget}) within one {K}-round launch; "
+                    "raise ForestConfig.fit_budget or lower rounds_per_launch"
+                )
+            t0 = time.perf_counter()
+            state, (rounds_y, labeled_y, acc_y, _picked_y, active_y) = chunk_fn(
+                codes, state, aux, fit_key, test_x, test_y, end_round
+            )
+            # The chunk's ONE host touchdown: fetch the stacked ys, bulk-append
+            # records, log, maybe checkpoint.
+            active_np = np.asarray(active_y)
+            wall = time.perf_counter() - t0
+            n_active = int(active_np.sum())
+            if n_active == 0:
+                break
+            rounds_np = np.asarray(rounds_y)[active_np]
+            labeled_np = np.asarray(labeled_y)[active_np]
+            acc_np = np.asarray(acc_y)[active_np]
+            result.extend_from_arrays(
+                rounds_np, labeled_np, n_pool - labeled_np, acc_np,
+                total_time=wall / n_active,
+            )
+            round_idx = int(rounds_np[-1])
+            # Post-reveal count of the last active round: its pre-reveal count
+            # plus at most one window. If that bound reaches label_cap the next
+            # round is necessarily inactive (a short reveal only happens on
+            # pool exhaustion, which also stops), so breaking on the bound
+            # never skips a round the per-round driver would have run.
+            n_known = min(int(labeled_np[-1]) + window, n_pool)
+            if cfg.log_every:
+                for r, nl, a in zip(rounds_np, labeled_np, acc_np):
+                    if int(r) % cfg.log_every == 0:
+                        dbg.debug(
+                            f"Iteration {int(r)} -- labeled={int(nl)} "
+                            f"accu={float(a) * 100:.2f}"
+                        )
+            if (
+                cfg.checkpoint_dir
+                and cfg.checkpoint_every
+                and round_idx // cfg.checkpoint_every > ckpt_mark // cfg.checkpoint_every
+            ):
+                # Chunk-boundary checkpointing: saved at the first touchdown
+                # after each checkpoint_every multiple (steps need not align
+                # with the multiple itself — runtime/checkpoint.py notes).
+                from distributed_active_learning_tpu.runtime import (
+                    checkpoint as ckpt_lib,
+                )
+
+                ckpt_lib.save(
+                    cfg.checkpoint_dir, state, result,
+                    fingerprint=ckpt_fp, kernel=ckpt_kernel,
+                )
+                ckpt_mark = round_idx
+            if not active_np.all():
+                break  # an in-chunk round hit the budget/pool stop
+
+        if cfg.results_path:
+            result.save(cfg.results_path, fmt="reference")
+        return result
+
     while True:
         n_labeled = int(state_lib.labeled_count(state))
         if n_labeled >= n_pool:

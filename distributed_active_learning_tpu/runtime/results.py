@@ -35,6 +35,40 @@ class ExperimentResult:
     def append(self, rec: RoundRecord) -> None:
         self.records.append(rec)
 
+    def extend_from_arrays(
+        self,
+        rounds,
+        n_labeled,
+        n_unlabeled,
+        accuracy,
+        total_time=None,
+    ) -> None:
+        """Bulk append from stacked per-round arrays — the chunked driver's
+        touchdown path (runtime/loop.py ``make_chunk_fn``): one ``lax.scan``
+        launch returns K rounds of outputs as stacked ys, and the host appends
+        them all at once instead of paying a record append + host sync per
+        round. ``total_time`` (optional, scalar or per-round) lands in
+        ``total_time`` with the per-phase splits zero — phase attribution
+        inside a fused scan would need per-round host syncs, exactly what the
+        chunk exists to avoid.
+        """
+        n = len(rounds)
+        times = total_time
+        if times is None:
+            times = [0.0] * n
+        elif not hasattr(times, "__len__"):
+            times = [float(times)] * n
+        for i in range(n):
+            self.append(
+                RoundRecord(
+                    round=int(rounds[i]),
+                    n_labeled=int(n_labeled[i]),
+                    n_unlabeled=int(n_unlabeled[i]),
+                    accuracy=float(accuracy[i]),
+                    total_time=float(times[i]),
+                )
+            )
+
     @property
     def final_accuracy(self) -> Optional[float]:
         return self.records[-1].accuracy if self.records else None

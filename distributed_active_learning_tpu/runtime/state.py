@@ -154,6 +154,21 @@ def pad_for_sharding(state: PoolState, multiple: int) -> PoolState:
     )
 
 
+def select_state(pred: jnp.ndarray, on_true: PoolState, on_false: PoolState) -> PoolState:
+    """Scalar-predicated state select: ``on_true`` if ``pred`` else ``on_false``.
+
+    The chunked driver's masked no-op reveal (runtime/loop.py
+    ``make_chunk_fn``): rounds past the label budget / pool exhaustion inside a
+    ``lax.scan`` chunk must leave the carried state EXACTLY unchanged — mask,
+    PRNG key, and round counter all frozen — so stopping stays exact rather
+    than chunk-quantized, and a resumed or per-round run sees identical state.
+    ``lax.cond`` (not ``jnp.where`` per leaf) so typed PRNG keys select
+    cleanly; both arguments are already-computed pytrees, so no compute is
+    duplicated.
+    """
+    return jax.lax.cond(pred, lambda: on_true, lambda: on_false)
+
+
 def reveal(state: PoolState, picked_idx: jnp.ndarray) -> PoolState:
     """Label the picked pool indices (the oracle call) and advance the round.
 

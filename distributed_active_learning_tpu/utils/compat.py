@@ -1,0 +1,41 @@
+"""Version shims for JAX APIs that moved between 0.4.x and current releases.
+
+The rig pins jax 0.4.37, where ``shard_map`` still lives in
+``jax.experimental.shard_map`` and its replication-check kwarg is spelled
+``check_rep``; newer releases promote it to ``jax.shard_map`` with the kwarg
+renamed ``check_vma``. Call sites import :func:`shard_map` from here and
+always use the modern ``check_vma`` spelling — the shim translates downward
+when needed, so the codebase reads like current JAX while running on the
+pinned one.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+import jax
+
+try:  # jax >= 0.6: public API
+    _shard_map = jax.shard_map
+except AttributeError:  # jax 0.4.x / 0.5.x: experimental home
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+# The kwarg spelling is detected from the signature, NOT from where the
+# function lives: the top-level promotion and the check_rep -> check_vma
+# rename happened in different releases, so inferring one from the other
+# mistranslates on the versions in between.
+try:
+    _CHECK_KW = (
+        "check_vma"
+        if "check_vma" in inspect.signature(_shard_map).parameters
+        else "check_rep"
+    )
+except (ValueError, TypeError):  # signature unavailable: assume modern
+    _CHECK_KW = "check_vma"
+
+
+def shard_map(f, /, **kwargs):
+    """``jax.shard_map`` across JAX versions (modern kwarg spellings only)."""
+    if "check_vma" in kwargs and _CHECK_KW != "check_vma":
+        kwargs[_CHECK_KW] = kwargs.pop("check_vma")
+    return _shard_map(f, **kwargs)

@@ -1,0 +1,214 @@
+"""Scan-fused chunked driver (runtime.loop.make_chunk_fn): parity with the
+per-round driver.
+
+The chunked driver exists purely to cut host launches (3 per round -> <= 3/K);
+it must never change results. These tests pin that down at both levels: the
+experiment driver (records identical for K that do and don't divide the round
+count, budget stops exact mid-chunk, sharded mesh path) and the raw chunk
+program (picked indices and final labeled mask bit-identical to stepping the
+round function by hand).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_active_learning_tpu.config import (
+    DataConfig,
+    ExperimentConfig,
+    ForestConfig,
+    MeshConfig,
+    StrategyConfig,
+)
+from distributed_active_learning_tpu.runtime.loop import run_experiment
+
+
+def _cfg(rounds_per_launch, strategy="uncertainty", **kw):
+    return ExperimentConfig(
+        data=DataConfig(name="checkerboard2x2", seed=3),
+        forest=kw.pop("forest", ForestConfig(n_trees=10, max_depth=4, fit="device")),
+        strategy=StrategyConfig(name=strategy, window_size=20),
+        n_start=10,
+        max_rounds=kw.pop("max_rounds", 6),
+        seed=kw.pop("seed", 0),
+        rounds_per_launch=rounds_per_launch,
+        **kw,
+    )
+
+
+def _assert_records_equal(a, b):
+    assert [r.round for r in a.records] == [r.round for r in b.records]
+    assert [r.n_labeled for r in a.records] == [r.n_labeled for r in b.records]
+    assert [r.n_unlabeled for r in a.records] == [r.n_unlabeled for r in b.records]
+    # Bit-identical, not allclose: the chunk runs the SAME jitted fit/round/
+    # accuracy programs, only batched under a scan.
+    assert [r.accuracy for r in a.records] == [r.accuracy for r in b.records]
+
+
+# K=1 exercises the config no-op (per-round path), K=4 chunk boundaries
+# landing inside the run, K=7 a chunk that overruns max_rounds=6 — the
+# masked-no-op tail must not add, drop, or perturb records.
+@pytest.mark.parametrize("strategy", ["uncertainty", "density"])
+@pytest.mark.parametrize("k", [1, 4, 7])
+def test_chunked_matches_per_round_driver(k, strategy):
+    base = run_experiment(_cfg(1, strategy=strategy))
+    chunked = run_experiment(_cfg(k, strategy=strategy))
+    assert len(base.records) == 6
+    _assert_records_equal(chunked, base)
+
+
+def test_label_budget_stops_exactly_mid_chunk():
+    """budget=50 is reached on round 3 of a K=4 chunk: the scan overruns the
+    stop, the masked no-op freezes the state, and the recorded stop point is
+    identical to the per-round driver's — stopping is exact, never
+    chunk-quantized."""
+    base = run_experiment(_cfg(1, label_budget=50, max_rounds=100))
+    chunked = run_experiment(_cfg(4, label_budget=50, max_rounds=100))
+    _assert_records_equal(chunked, base)
+    assert chunked.records[-1].n_labeled < 50
+    assert chunked.records[-1].n_labeled + 20 >= 50
+
+
+def test_host_fit_silently_falls_back_to_per_round():
+    """rounds_per_launch > 1 with the sklearn host fit cannot fuse (the fit is
+    a host call by construction); the driver must fall back, not fail, and
+    produce the per-round curve."""
+    base = run_experiment(_cfg(1, forest=ForestConfig(n_trees=10, max_depth=4, fit="host")))
+    chunked = run_experiment(_cfg(4, forest=ForestConfig(n_trees=10, max_depth=4, fit="host")))
+    _assert_records_equal(chunked, base)
+    # Fallback means real per-phase timings exist (the chunk can't attribute).
+    assert all(r.train_time > 0 for r in chunked.records)
+
+
+def test_fit_window_guard_accepts_reachable_tail():
+    """950 of 1000 labeled, fit_budget=960, window=100: only ONE more round
+    can ever be active and it fits 950 rows. The chunk's pre-launch guard
+    must project over the reachable count lattice (950), not label_cap - 1
+    (999) — the latter falsely rejected configs the per-round driver runs."""
+    def cfg(k):
+        return ExperimentConfig(
+            data=DataConfig(name="checkerboard2x2", seed=3),
+            forest=ForestConfig(n_trees=10, max_depth=4, fit="device", fit_budget=960),
+            strategy=StrategyConfig(name="uncertainty", window_size=100),
+            n_start=950,
+            max_rounds=10,
+            seed=0,
+            rounds_per_launch=k,
+        )
+
+    base = run_experiment(cfg(1))
+    chunked = run_experiment(cfg(4))  # raised ValueError before the lattice fix
+    _assert_records_equal(chunked, base)
+    assert [r.n_labeled for r in chunked.records] == [950]
+
+
+def test_chunked_checkpoint_resume_bit_identical(tmp_path):
+    """Chunk-boundary checkpoints (saved at the first touchdown at/after each
+    checkpoint_every multiple) must resume into a curve bit-identical to an
+    uninterrupted PER-ROUND run — crossing both the driver kind and the
+    interruption. fit_budget is pinned because the device fit's bootstrap
+    draws depend on the window's static size, and the budget otherwise
+    defaults from max_rounds (which legitimately differs across the runs)."""
+    import os
+
+    ckpt = os.path.join(tmp_path, "ckpt")
+    forest = ForestConfig(n_trees=10, max_depth=4, fit="device", fit_budget=256)
+    full = run_experiment(_cfg(1, forest=forest, max_rounds=8, seed=4))
+    run_experiment(
+        _cfg(3, forest=forest, max_rounds=4, seed=4,
+             checkpoint_dir=ckpt, checkpoint_every=1)
+    )
+    # K=3 over 4 rounds -> touchdowns (and saves) land at rounds 3 and 4.
+    assert sorted(os.listdir(ckpt)) == ["alstate_3.npz", "alstate_4.npz"]
+    resumed = run_experiment(
+        _cfg(3, forest=forest, max_rounds=4, seed=4,
+             checkpoint_dir=ckpt, checkpoint_every=1)
+    )
+    assert [r.round for r in resumed.records] == list(range(1, 9))
+    assert [r.accuracy for r in resumed.records] == [
+        r.accuracy for r in full.records
+    ]
+
+
+def test_chunk_fn_picked_and_mask_match_manual_rounds():
+    """Raw chunk-program parity: the scan's stacked picked indices and the
+    carried-out labeled mask are bit-identical to stepping fit -> round by
+    hand — the strongest form of the driver-level record checks above."""
+    from distributed_active_learning_tpu.data.datasets import get_dataset
+    from distributed_active_learning_tpu.ops import trees_train
+    from distributed_active_learning_tpu.runtime import state as state_lib
+    from distributed_active_learning_tpu.runtime.loop import (
+        make_chunk_fn,
+        make_device_fit,
+        make_round_fn,
+    )
+    from distributed_active_learning_tpu.strategies import StrategyAux, get_strategy
+
+    cfg = _cfg(4)
+    K, window = 4, cfg.strategy.window_size
+    bundle = get_dataset(cfg.data)
+    state0 = state_lib.init_pool_state(
+        bundle.train_x, bundle.train_y, jax.random.key(cfg.seed)
+    )
+    state0 = state_lib.set_start_state(state0, cfg.n_start)
+    binned = trees_train.make_bins(jnp.asarray(state0.x), cfg.forest.max_bins)
+    budget = cfg.n_start + (K + 1) * window
+    device_fit = make_device_fit(cfg, binned.edges, budget)
+    strategy = get_strategy(cfg.strategy)
+    round_fn = make_round_fn(strategy, window)
+    aux = StrategyAux(seed_mask=state0.labeled_mask)
+    fit_key = jax.random.key(cfg.seed + 0x5EED)
+    tx, ty = jnp.asarray(bundle.test_x), jnp.asarray(bundle.test_y)
+
+    chunk_fn = make_chunk_fn(strategy, window, K, device_fit, label_cap=state0.n_valid)
+    end_round = jnp.int32(np.iinfo(np.int32).max)
+    chunk_state, (rounds_y, labeled_y, _acc_y, picked_y, active_y) = chunk_fn(
+        binned.codes, state0, aux, fit_key, tx, ty, end_round
+    )
+    assert bool(np.asarray(active_y).all())  # cap/end never hit in K rounds
+
+    st = state0
+    for i in range(K):
+        forest = device_fit(
+            binned.codes, st, jax.random.fold_in(fit_key, st.round + 1)
+        )
+        st, picked, _ = round_fn(forest, st, aux)
+        np.testing.assert_array_equal(np.asarray(picked_y)[i], np.asarray(picked))
+        assert int(np.asarray(rounds_y)[i]) == int(st.round)
+    np.testing.assert_array_equal(
+        np.asarray(chunk_state.labeled_mask), np.asarray(st.labeled_mask)
+    )
+    np.testing.assert_array_equal(
+        jax.random.key_data(chunk_state.key), jax.random.key_data(st.key)
+    )
+
+
+def test_chunked_driver_on_sharded_mesh(devices):
+    """The chunked scan must run under the sharded round path — 4x2 mesh,
+    pallas kernel re-wrapped per-shard inside the scan — and match the
+    single-device per-round curve (sharding and chunking are both placement/
+    launch decisions, never semantic ones)."""
+
+    def cfg(k, mesh):
+        return ExperimentConfig(
+            data=DataConfig(name="checkerboard2x2", n_samples=250, seed=2),
+            forest=ForestConfig(n_trees=8, max_depth=4, fit="device", kernel="pallas"),
+            strategy=StrategyConfig(name="uncertainty", window_size=10),
+            mesh=mesh,
+            n_start=10,
+            max_rounds=5,
+            seed=7,
+            rounds_per_launch=k,
+        )
+
+    single = run_experiment(cfg(1, MeshConfig()))
+    chunked = run_experiment(cfg(4, MeshConfig(data=4, model=2)))
+    assert [r.n_labeled for r in chunked.records] == [
+        r.n_labeled for r in single.records
+    ]
+    np.testing.assert_allclose(
+        [r.accuracy for r in chunked.records],
+        [r.accuracy for r in single.records],
+        atol=1e-6,
+    )
