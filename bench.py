@@ -531,13 +531,22 @@ def _bench_scan_fusion(args, pool, pool_y, mask0, binned):
     tx, ty = state0.x[:2048], state0.oracle_y[:2048]
     end_round = np.iinfo(np.int32).max
 
+    # Metrics ON, like the production --metrics-out path: the acceptance bar
+    # is scan fusion keeping its win WITH per-round RoundMetrics riding the
+    # ys (the entropy pass CSEs against the scoring pass inside the program,
+    # so the target regression is <3%). donate=False because this bench
+    # re-launches the chunk from the SAME state0 every rep — the driver
+    # donates, but a donated state0 would be a deleted buffer on rep 2.
     chunk_fn = make_chunk_fn(
-        strategy, window, K, device_fit, label_cap=state0.n_valid
+        strategy, window, K, device_fit, label_cap=state0.n_valid,
+        with_metrics=True, donate=False,
     )
 
     def run_chunked():
         _, ys = chunk_fn(binned.codes, state0, aux, fit_key, tx, ty, end_round)
-        np.asarray(ys[4])  # the driver's one touchdown: fetch the stacked ys
+        # The driver's one touchdown: fetch the stacked ys + metrics pytree.
+        np.asarray(ys[4])
+        jax.device_get(ys[5])
 
     def run_per_round():
         st = state0
@@ -548,17 +557,32 @@ def _bench_scan_fusion(args, pool, pool_y, mask0, binned):
             jax.block_until_ready(picked)
             float(_accuracy(forest, tx, ty))
 
+    # Launch accounting (runtime/telemetry.py): the first call's wall time is
+    # trace + XLA compile + execute; folding it into the JSON makes compile
+    # regressions visible next to the steady-state numbers they pollute.
+    from distributed_active_learning_tpu.runtime import telemetry
+
+    t0 = time.perf_counter()
     run_chunked()   # compile
+    chunk_first_call = time.perf_counter() - t0
     run_per_round() # compile
     reps = max(min(args.iters, 5), 2)
     chunk_sec = _median_time(run_chunked, reps) / K
     per_round_sec = _median_time(run_per_round, reps) / K
-    return {
+    out = {
         "rounds_per_launch": K,
         "scan_seconds_per_round": round(chunk_sec, 4),
         "per_round_driver_seconds_per_round": round(per_round_sec, 4),
         "scan_fusion_speedup": round(per_round_sec / chunk_sec, 2),
+        "scan_metrics_enabled": True,
+        "chunk_first_call_seconds": round(chunk_first_call, 4),
+        "chunk_compile_overhead_seconds": round(
+            max(chunk_first_call - chunk_sec * K, 0.0), 4
+        ),
+        "chunk_jit_cache_entries": telemetry.jit_cache_size(chunk_fn),
     }
+    out.update(telemetry.device_memory_gauges())
+    return out
 
 
 def bench_lal(args):
@@ -857,6 +881,12 @@ def _run_mode(args) -> dict:
             "scan_seconds_per_round": rd["scan_seconds_per_round"],
             "per_round_driver_seconds_per_round": rd["per_round_driver_seconds_per_round"],
             "scan_fusion_speedup": rd["scan_fusion_speedup"],
+            "scan_metrics_enabled": rd["scan_metrics_enabled"],
+            "chunk_first_call_seconds": rd["chunk_first_call_seconds"],
+            "chunk_compile_overhead_seconds": rd["chunk_compile_overhead_seconds"],
+            "chunk_jit_cache_entries": rd["chunk_jit_cache_entries"],
+            # Memory watermarks ride only when the backend reports them (TPU).
+            **{k: v for k, v in rd.items() if k.startswith("device_")},
         })
     if want("lal"):
         ll = bench_lal(args)

@@ -1,0 +1,159 @@
+"""Turn a --metrics-out JSONL stream into the reference's per-phase table.
+
+The reference's observability artifact is ``classes/RESULTS.txt``: TIMESTAMP
+banners with per-phase elapsed seconds plus per-iteration accuracy prints,
+assembled by hand from redirected stdout. This tool rebuilds that view — and
+more — from the structured events ``runtime/telemetry.py`` emits:
+
+    python benches/summarize_metrics.py results/metrics.jsonl
+
+Sections:
+
+- **rounds** — count, labeled range, first/final accuracy, mean pool entropy
+  drop (the in-scan RoundMetrics riding each ``round`` event);
+- **phases** — total/mean wall seconds per phase (train/round/eval) where the
+  per-round driver recorded them, the table the reference printed;
+- **launches** — compile-vs-execute split of the scan-fused chunk program and
+  any recompiles the jit cache detected;
+- **counters / gauges** — host transfer bytes, device memory watermarks.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List
+
+
+def load_events(path: str) -> List[dict]:
+    events = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                events.append(json.loads(line))
+    return events
+
+
+def _fmt_row(cols, widths):
+    return "  ".join(str(c).ljust(w) for c, w in zip(cols, widths))
+
+
+def _table(header, rows):
+    widths = [
+        max(len(str(h)), *(len(str(r[i])) for r in rows)) if rows else len(str(h))
+        for i, h in enumerate(header)
+    ]
+    lines = [_fmt_row(header, widths), _fmt_row(["-" * w for w in widths], widths)]
+    lines += [_fmt_row(r, widths) for r in rows]
+    return "\n".join(lines)
+
+
+def summarize(events: List[dict]) -> str:
+    out = []
+    rounds = [e for e in events if e.get("kind") == "round"]
+    launches = [e for e in events if e.get("kind") == "launch"]
+    counters: Dict[str, float] = {}
+    for e in events:
+        if e.get("kind") == "counter":
+            counters[e["name"]] = e["total"]
+    gauges: Dict[str, dict] = {}
+    for e in events:
+        if e.get("kind") == "gauge":
+            gauges[e["name"]] = e  # last observation wins (watermarks grow)
+
+    meta = next((e for e in events if e.get("kind") == "meta"), None)
+    if meta is not None:
+        backend = meta.get("backend", "?")
+        out.append(
+            f"run: backend={backend} devices={meta.get('n_devices', '?')} "
+            f"processes={meta.get('process_count', '?')}"
+        )
+
+    if rounds:
+        first, last = rounds[0], rounds[-1]
+        row = [
+            len(rounds),
+            f"{first.get('n_labeled', '?')}..{last.get('n_labeled', '?')}",
+            f"{100 * first.get('accuracy', 0):.2f} -> {100 * last.get('accuracy', 0):.2f}",
+        ]
+        header = ["rounds", "labeled", "accuracy %"]
+        ents = [e["pool_entropy"] for e in rounds if "pool_entropy" in e]
+        if ents:
+            header.append("pool entropy (bits)")
+            row.append(f"{ents[0]:.4f} -> {ents[-1]:.4f}")
+        margins = [e["score_margin"] for e in rounds if "score_margin" in e]
+        if margins:
+            header.append("mean margin")
+            row.append(f"{sum(margins) / len(margins):.5f}")
+        out.append("\n== rounds ==\n" + _table(header, [row]))
+
+    # Per-phase totals — the reference's TIMESTAMP table. Phase times appear
+    # on round events when the per-round driver ran; the scan-fused driver
+    # attributes per program launch instead (next section).
+    phase_rows = []
+    for phase in ("train", "score", "eval"):
+        key = f"{phase}_time"
+        vals = [e[key] for e in rounds if e.get(key)]
+        if vals:
+            phase_rows.append(
+                [phase, len(vals), f"{sum(vals):.3f}", f"{sum(vals) / len(vals):.4f}"]
+            )
+    if phase_rows:
+        out.append(
+            "\n== phases ==\n"
+            + _table(["phase", "calls", "total s", "mean s"], phase_rows)
+        )
+
+    if launches:
+        rows = []
+        for program in sorted({e["program"] for e in launches}):
+            evs = [e for e in launches if e["program"] == program]
+            first = next((e for e in evs if e.get("first_call")), None)
+            steady = [e["seconds"] for e in evs if not e.get("first_call")]
+            rows.append(
+                [
+                    program,
+                    len(evs),
+                    f"{first['seconds']:.3f}" if first else "-",
+                    f"{sum(steady) / len(steady):.4f}" if steady else "-",
+                    sum(1 for e in evs if e.get("recompiled")),
+                ]
+            )
+        out.append(
+            "\n== launches ==\n"
+            + _table(
+                ["program", "calls", "first (compile) s", "steady mean s", "recompiles"],
+                rows,
+            )
+        )
+
+    if counters:
+        rows = [[k, f"{v:,.0f}"] for k, v in sorted(counters.items())]
+        out.append("\n== counters ==\n" + _table(["counter", "total"], rows))
+    if gauges:
+        rows = []
+        for name, e in sorted(gauges.items()):
+            val = e["value"]
+            extra = f" per_host={e['per_host']}" if "per_host" in e else ""
+            rows.append([name, f"{val:,}" if isinstance(val, int) else val, extra])
+        out.append("\n== gauges ==\n" + _table(["gauge", "value", ""], rows))
+
+    if not out:
+        return "(no telemetry events found)"
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Summarize a --metrics-out JSONL stream into per-phase tables"
+    )
+    ap.add_argument("path", help="metrics JSONL file (run.py --metrics-out)")
+    args = ap.parse_args(argv)
+    print(summarize(load_events(args.path)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -141,6 +141,13 @@ class ExperimentConfig:
     rounds_per_launch: int = 1
     seed: int = 0
     # Observability
+    # Compute per-round RoundMetrics (runtime/telemetry.py) on device and
+    # attach them to records: selection-score summary, margin to the best
+    # unpicked candidate, mean pool entropy, picked-class histogram, labeled
+    # fraction. In the scan-fused driver they ride the existing chunk ys —
+    # no extra host syncs; a MetricsWriter passed to run_experiment enables
+    # this implicitly.
+    collect_metrics: bool = False
     log_every: int = 1
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 0  # 0 = disabled

@@ -154,7 +154,10 @@ class NeuralLearner:
             return TrainState(params, opt_state, state.step + 1), None
 
         keys = jax.random.split(key, self.train_steps)
-        state, _ = jax.lax.scan(step, state, keys)
+        # Trace attribution: the whole minibatch-SGD scan shows as one
+        # labelled block in a --profile-dir trace (runtime/telemetry.py).
+        with jax.named_scope("neural/train"):
+            state, _ = jax.lax.scan(step, state, keys)
         return state
 
     @functools.partial(jax.jit, static_argnums=0)
@@ -194,7 +197,8 @@ class NeuralLearner:
 
             return _chunked(chunk_apply, x, self.predict_chunk)
 
-        return jax.vmap(one_sample)(keys)
+        with jax.named_scope("neural/mc_predict"):
+            return jax.vmap(one_sample)(keys)
 
     def accuracy(self, state: TrainState, x: jnp.ndarray, y: jnp.ndarray) -> float:
         probs = self.predict_proba(state, x)

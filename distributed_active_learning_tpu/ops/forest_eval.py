@@ -31,6 +31,7 @@ from __future__ import annotations
 
 from typing import Union
 
+import jax
 import jax.numpy as jnp
 
 from distributed_active_learning_tpu.ops import trees, trees_gemm, trees_pallas
@@ -59,39 +60,43 @@ def _is_pallas(forest: Forest) -> bool:
 
 def leaves(forest: Forest, x: jnp.ndarray) -> jnp.ndarray:
     """Per-tree leaf values ``[n, T]`` via whichever kernel the forest carries."""
-    if _is_pallas(forest):
-        return trees_pallas.predict_leaves(forest, x)
-    if _is_gemm(forest):
-        return trees_gemm.predict_leaves_gemm(forest, x)
-    return trees.predict_leaves(forest, x)
+    with jax.named_scope("forest/leaves"):
+        if _is_pallas(forest):
+            return trees_pallas.predict_leaves(forest, x)
+        if _is_gemm(forest):
+            return trees_gemm.predict_leaves_gemm(forest, x)
+        return trees.predict_leaves(forest, x)
 
 
 def proba(forest: Forest, x: jnp.ndarray) -> jnp.ndarray:
     """P(class 1) per point ``[n]`` (mean of per-tree leaf probabilities)."""
-    if _is_pallas(forest):
-        return trees_pallas.predict_proba(forest, x)
-    if _is_gemm(forest):
-        return trees_gemm.predict_proba_gemm(forest, x)
-    return trees.predict_proba(forest, x)
+    with jax.named_scope("forest/proba"):
+        if _is_pallas(forest):
+            return trees_pallas.predict_proba(forest, x)
+        if _is_gemm(forest):
+            return trees_gemm.predict_proba_gemm(forest, x)
+        return trees.predict_proba(forest, x)
 
 
 def votes(forest: Forest, x: jnp.ndarray) -> jnp.ndarray:
     """Hard positive-vote count per point ``[n]`` (``uncertainty_sampling.py:96``)."""
-    if _is_pallas(forest):
-        return trees_pallas.predict_votes(forest, x)
-    if _is_gemm(forest):
-        return trees_gemm.predict_votes_gemm(forest, x)
-    return trees.predict_votes(forest, x)
+    with jax.named_scope("forest/votes"):
+        if _is_pallas(forest):
+            return trees_pallas.predict_votes(forest, x)
+        if _is_gemm(forest):
+            return trees_gemm.predict_votes_gemm(forest, x)
+        return trees.predict_votes(forest, x)
 
 
 def value(forest: Forest, x: jnp.ndarray) -> jnp.ndarray:
     """Regression prediction per point ``[n]`` (the LAL-regressor predict,
     ``active_learner.py:319-321``)."""
-    if _is_pallas(forest):
-        return trees_pallas.predict_proba(forest, x)
-    if _is_gemm(forest):
-        return trees_gemm.predict_proba_gemm(forest, x)
-    return trees.predict_value(forest, x)
+    with jax.named_scope("forest/value"):
+        if _is_pallas(forest):
+            return trees_pallas.predict_proba(forest, x)
+        if _is_gemm(forest):
+            return trees_gemm.predict_proba_gemm(forest, x)
+        return trees.predict_value(forest, x)
 
 
 def for_kernel(forest: trees.PackedForest, kernel: str) -> Forest:

@@ -28,9 +28,17 @@ def positive_entropy(p_pos: jnp.ndarray, eps: float = 1e-12) -> jnp.ndarray:
     return -q * jnp.log2(q)
 
 
-def full_entropy(p_pos: jnp.ndarray, eps: float = 1e-12) -> jnp.ndarray:
+def full_entropy(p_pos: jnp.ndarray, eps: float = 1e-6) -> jnp.ndarray:
     """Standard binary entropy in bits — the statistically-correct variant the
-    reference approximates; exposed for the neural/deep-AL configs."""
+    reference approximates; exposed for the neural/deep-AL configs and the
+    telemetry pool-entropy gauge.
+
+    ``eps`` must stay float32-representable: with the former 1e-12,
+    ``1.0 - eps`` rounds back to exactly 1.0 in f32, so a unanimous forest
+    (p = 1) produced ``0 * log2(0) = nan`` — which then poisoned any mean
+    over the pool (the telemetry gauge surfaced this; the clip was a no-op
+    at both ends).
+    """
     p = jnp.clip(p_pos, eps, 1.0 - eps)
     return -(p * jnp.log2(p) + (1.0 - p) * jnp.log2(1.0 - p))
 

@@ -17,7 +17,6 @@ Two styles of distribution, both used:
 from __future__ import annotations
 
 import functools
-from typing import Tuple
 
 import jax
 import jax.numpy as jnp
@@ -94,7 +93,8 @@ def sharded_votes(mesh: Mesh):
             local = jnp.sum(forest_eval.leaves(f_local, x_blk) > 0.5, axis=1)
             return vector_accumulate(local.astype(jnp.int32), mesh_lib.AXIS_MODEL)
 
-        return kernel(forest, x)
+        with jax.named_scope("shard/votes"):
+            return kernel(forest, x)
 
     return votes_fn
 
@@ -125,25 +125,39 @@ def sharded_similarity_mass(mesh: Mesh):
         pooled = vector_accumulate(local_pooled, mesh_lib.AXIS_DATA)
         return jnp.matmul(xn, pooled, precision=lax.Precision.HIGHEST)
 
-    return mass_kernel
+    def mass(x: jnp.ndarray, count_mask: jnp.ndarray) -> jnp.ndarray:
+        with jax.named_scope("shard/similarity_mass"):
+            return mass_kernel(x, count_mask)
+
+    return mass
 
 
-def make_sharded_round_fn(strategy: Strategy, window_size: int, mesh: Mesh):
+def make_sharded_round_fn(
+    strategy: Strategy,
+    window_size: int,
+    mesh: Mesh,
+    with_metrics: bool = False,
+    n_classes: int = 2,
+):
     """The full AL round over a device mesh (GSPMD style).
 
     Returns ``(forest, state, aux) -> (new_state, picked, scores)`` where the
     caller is expected to have placed ``state`` via
     :func:`parallel.mesh.shard_pool_state` and ``forest`` via
     :func:`parallel.mesh.shard_forest`; jit then compiles one SPMD program over
-    the mesh, keeping outputs in their input shardings.
+    the mesh, keeping outputs in their input shardings. ``with_metrics``
+    passes through to :func:`runtime.loop.make_round_fn`: the in-scan
+    :class:`~runtime.telemetry.RoundMetrics` reductions are plain jnp ops, so
+    GSPMD partitions them with the round — metrics under a mesh match the
+    single-device values the same way accuracies do.
     """
     from distributed_active_learning_tpu.runtime.loop import make_round_fn
 
-    round_fn = make_round_fn(strategy, window_size)
+    round_fn = make_round_fn(
+        strategy, window_size, with_metrics=with_metrics, n_classes=n_classes
+    )
 
-    def sharded_round(
-        forest: PackedForest, state: PoolState, aux: StrategyAux
-    ) -> Tuple[PoolState, jnp.ndarray, jnp.ndarray]:
+    def sharded_round(forest: PackedForest, state: PoolState, aux: StrategyAux):
         # Inputs carry NamedShardings (committed by device_put); jit compiles
         # one SPMD executable over the mesh from those placements. Guard
         # against inputs placed on a *different* mesh than the declared one.

@@ -105,6 +105,29 @@ def process_count() -> int:
     return jax.process_count()
 
 
+def gather_scalar_gauges(values: dict) -> dict:
+    """Allgather a dict of per-host scalar gauges -> ``{name: [v_host0, ...]}``.
+
+    COLLECTIVE when the job spans processes — every process must call it at
+    the same point with the same key set (the telemetry touchdowns are
+    symmetric across processes, same as the checkpoint gathers). Single-
+    process runs return one-element lists without touching any collective.
+    Used by :class:`runtime.telemetry.MetricsWriter` so the primary-only
+    JSONL stream still records every host's gauges.
+    """
+    names = sorted(values)
+    if jax.process_count() <= 1:
+        return {n: [float(values[n])] for n in names}
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    local = np.asarray([float(values[n]) for n in names], dtype=np.float64)
+    gathered = np.asarray(
+        multihost_utils.process_allgather(local)
+    ).reshape(jax.process_count(), len(names))
+    return {n: [float(v) for v in gathered[:, i]] for i, n in enumerate(names)}
+
+
 def host_np(x):
     """``np.asarray`` that also works for global arrays spanning processes.
 

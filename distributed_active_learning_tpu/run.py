@@ -69,6 +69,29 @@ def build_parser() -> argparse.ArgumentParser:
         "results identical, stopping exact). 1 = per-round driver",
     )
     ap.add_argument("--seed", type=int, default=0)
+    # Observability (runtime/telemetry.py): structured JSONL metrics stream
+    # and jax.profiler trace capture.
+    ap.add_argument(
+        "--metrics-out", default=None, metavar="PATH",
+        help="write rank-tagged JSONL telemetry events: one 'round' event per "
+        "AL round (with device-computed score/entropy/histogram metrics — "
+        "fused runs emit them from the scan itself, no extra host syncs), "
+        "plus launch accounting, transfer counters, and memory gauges; "
+        "summarize with benches/summarize_metrics.py",
+    )
+    ap.add_argument(
+        "--profile-dir", default=None, metavar="DIR",
+        help="capture a jax.profiler trace of the whole run into DIR (open "
+        "in TensorBoard's Profile plugin or Perfetto); phases and hot ops "
+        "are name-scoped, so device time is attributable per AL phase",
+    )
+    ap.add_argument(
+        "--phase-detail", action="store_true",
+        help="force per-phase (train/round/eval) host wall splits; with "
+        "--rounds-per-launch > 1 this disables scan fusion (phases cannot "
+        "be attributed inside one fused launch) — prefer --profile-dir for "
+        "attribution that keeps fusion",
+    )
     ap.add_argument("--out", default=None, help="write reference-format results log")
     ap.add_argument("--plot", default=None, help="save accuracy/time curves as PNG")
     ap.add_argument("--checkpoint-dir", default=None)
@@ -164,13 +187,23 @@ def main(argv=None) -> int:
     from distributed_active_learning_tpu.runtime.debugger import Debugger
     from distributed_active_learning_tpu.runtime.loop import run_experiment
 
-    # --rounds-per-launch > 1 is an explicit request for scan fusion: drop the
-    # per-phase wall splits (unattributable inside one fused launch) but keep
-    # the iteration logs. Default keeps full phase detail.
-    dbg = Debugger(
-        enabled=not args.quiet,
-        phase_detail=None if getattr(args, "rounds_per_launch", 1) <= 1 else False,
-    )
+    # phase_detail defaults False since the telemetry PR: an enabled Debugger
+    # no longer costs a fused run its scan fusion (per-round visibility comes
+    # from the in-scan RoundMetrics instead); --phase-detail opts back into
+    # host-timed phases. --quiet --rounds-per-launch K is therefore the
+    # zero-overhead fast path: no printer calls, chunked driver engaged.
+    dbg = Debugger(enabled=not args.quiet, phase_detail=args.phase_detail)
+    # Fail fast on an unwritable --profile-dir: jax.profiler only errors when
+    # the trace is flushed at run END, which would waste the whole experiment.
+    if args.profile_dir:
+        from distributed_active_learning_tpu.runtime.telemetry import (
+            prepare_profile_dir,
+        )
+
+        try:
+            prepare_profile_dir(args.profile_dir)
+        except ValueError as e:
+            ap.error(str(e))
     # Both loops gate persistence on dir AND interval; half a request would be
     # silently ignored, dropping the user's crash-resume protection.
     if bool(args.checkpoint_dir) != bool(args.checkpoint_every):
@@ -197,7 +230,13 @@ def main(argv=None) -> int:
                 f"--neural needs a deep strategy, got {args.strategy!r}; "
                 f"pick one of: {', '.join(available_deep_strategies())}"
             )
-        result = _run_neural(args, dbg)
+        writer = _make_writer(args)
+        try:
+            with _profile(args):
+                result = _run_neural(args, dbg, metrics=writer)
+        finally:
+            if writer is not None:
+                writer.close()
         _emit(args, result, dbg)
         return 0
 
@@ -239,12 +278,40 @@ def main(argv=None) -> int:
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every=args.checkpoint_every,
     )
-    result = run_experiment(cfg, debugger=dbg)
+    writer = _make_writer(args)
+    try:
+        with _profile(args):
+            result = run_experiment(cfg, debugger=dbg, metrics=writer)
+    finally:
+        if writer is not None:
+            writer.close()
     _emit(args, result, dbg)
     return 0
 
 
-def _run_neural(args, dbg):
+def _make_writer(args):
+    """Open the ``--metrics-out`` JSONL sink (None when the flag is absent).
+
+    Constructed on EVERY process of a multihost job — the writer's collective
+    gauge gathers must be symmetric — but only the primary holds the file.
+    """
+    if not args.metrics_out:
+        return None
+    from distributed_active_learning_tpu.runtime.telemetry import MetricsWriter
+
+    return MetricsWriter(args.metrics_out)
+
+
+def _profile(args):
+    """``--profile-dir`` jax.profiler session (no-op context when unset).
+    validate=False: main() already probed writability so a bad directory
+    fails as a clean argparse error before any work."""
+    from distributed_active_learning_tpu.runtime.telemetry import profile_session
+
+    return profile_session(args.profile_dir, validate=False)
+
+
+def _run_neural(args, dbg, metrics=None):
     """Deep-AL CLI path: a neural learner + MC-dropout over a registry dataset.
 
     Model selection covers BASELINE.json configs 4-5: ``--dataset cifar10
@@ -333,7 +400,7 @@ def _run_neural(args, dbg):
     # different dataset/subsample is refused (same guard as the forest loop).
     return run_neural_experiment(
         cfg, learner, bundle.train_x, bundle.train_y, bundle.test_x, bundle.test_y,
-        debugger=dbg, data_ident=dataclasses.asdict(data_cfg),
+        debugger=dbg, data_ident=dataclasses.asdict(data_cfg), metrics=metrics,
     )
 
 

@@ -272,7 +272,17 @@ def _restore_base(
             f"({state.n_valid},)"
         )
     new_state = state.replace(labeled_mask=mask, key=key, round=rnd)
-    new_result = ExperimentResult(records=[RoundRecord(**r) for r in records])
+    # Tolerant record rebuild: drop keys this build's RoundRecord doesn't
+    # know. Records gained a `metrics` field (the telemetry PR's in-scan
+    # RoundMetrics ride the records_json payload); a checkpoint written by a
+    # NEWER build with further fields must still resume here — the fields are
+    # observability, never loop state, so dropping unknowns is lossless for
+    # the resume itself.
+    known = {f.name for f in dataclasses.fields(RoundRecord)}
+    new_result = ExperimentResult(
+        records=[RoundRecord(**{k: v for k, v in r.items() if k in known})
+                 for r in records]
+    )
     return new_state, new_result
 
 

@@ -24,13 +24,16 @@ class Debugger:
     def __init__(self, enabled: bool = True, printer=print, phase_detail=None):
         self.enabled = enabled
         self.printer = printer
-        # Whether per-phase (train/score/eval) wall splits are wanted. An
-        # enabled debugger implies yes by default — and the chunked driver
-        # (runtime/loop.py make_chunk_fn) cannot attribute phases inside one
-        # fused scan launch, so it falls back to the per-round path when this
-        # is set. Pass phase_detail=False to keep prints/logs while opting
-        # into scan fusion (run.py does this for --rounds-per-launch > 1).
-        self.phase_detail = enabled if phase_detail is None else phase_detail
+        # Whether per-phase (train/score/eval) wall splits are REQUIRED. The
+        # chunked driver (runtime/loop.py make_chunk_fn) cannot attribute
+        # phases inside one fused scan launch, so phase_detail=True forces the
+        # per-round fallback. Default is False — since the in-scan
+        # RoundMetrics landed (runtime/telemetry.py), an enabled debugger no
+        # longer implies host-side phase syncs: fused runs keep per-round
+        # logs/metrics, and phase timing is an explicit opt-in. (Pre-telemetry
+        # this defaulted to `enabled`, which silently cost every logged run
+        # its scan fusion.)
+        self.phase_detail = bool(phase_detail) if phase_detail is not None else False
         self.records: List[Tuple[str, float]] = []
         self._start = time.perf_counter()
         self._last = self._start
@@ -57,10 +60,19 @@ class Debugger:
 
     @contextlib.contextmanager
     def phase(self, label: str):
-        """Nested phase timing as a context manager."""
+        """Nested phase timing as a context manager.
+
+        Each phase also opens a ``jax.profiler.TraceAnnotation`` span, so a
+        ``--profile-dir`` trace shows the host-side train/round/eval segments
+        by name alongside the device ops' ``jax.named_scope`` labels — the
+        attribution the reference's TIMESTAMP banners could never give.
+        """
+        import jax.profiler  # lazy: the Debugger must not force backend init
+
         t0 = time.perf_counter()
         try:
-            yield
+            with jax.profiler.TraceAnnotation(f"al_phase/{label}"):
+                yield
         finally:
             elapsed = time.perf_counter() - t0
             self.records.append((label, elapsed))

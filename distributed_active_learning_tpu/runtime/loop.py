@@ -39,27 +39,49 @@ from distributed_active_learning_tpu.runtime.results import ExperimentResult, Ro
 from distributed_active_learning_tpu.strategies import Strategy, StrategyAux, get_strategy
 
 
-def make_round_fn(strategy: Strategy, window_size: int):
+def make_round_fn(
+    strategy: Strategy,
+    window_size: int,
+    with_metrics: bool = False,
+    n_classes: int = 2,
+):
     """Build the jitted AL round: score pool -> masked top-k -> reveal.
 
     Static over (strategy, window_size); all dynamic state is pytree args, so
-    successive rounds reuse one compiled executable.
+    successive rounds reuse one compiled executable. With ``with_metrics`` the
+    round additionally computes a :class:`~runtime.telemetry.RoundMetrics`
+    pytree ON DEVICE (score summary, boundary margin, pool entropy, picked
+    histogram, labeled fraction) and returns it as a fourth output — both
+    drivers (per-round and scan-fused) then run the SAME metrics program, so
+    their metrics agree bit-for-bit like their accuracies do.
     """
 
     @jax.jit
     def round_fn(
         forest: forest_eval.Forest, state: state_lib.PoolState, aux: StrategyAux
-    ) -> Tuple[state_lib.PoolState, jnp.ndarray, jnp.ndarray]:
+    ):
         key, k_score = jax.random.split(state.key)
         state = state.replace(key=key)
-        scores = strategy.score(forest, state, k_score, aux)
+        with jax.named_scope("al/score"):
+            scores = strategy.score(forest, state, k_score, aux)
         unlabeled = ~state.labeled_mask
-        if strategy.higher_is_better:
-            _, picked = select_top_k(scores, unlabeled, window_size)
-        else:
-            _, picked = select_bottom_k(scores, unlabeled, window_size)
-        new_state = state_lib.reveal(state, picked)
-        return new_state, picked, scores
+        with jax.named_scope("al/select"):
+            if strategy.higher_is_better:
+                vals, picked = select_top_k(scores, unlabeled, window_size)
+            else:
+                vals, picked = select_bottom_k(scores, unlabeled, window_size)
+        with jax.named_scope("al/reveal"):
+            new_state = state_lib.reveal(state, picked)
+        if not with_metrics:
+            return new_state, picked, scores
+        from distributed_active_learning_tpu.runtime import telemetry
+
+        rm = telemetry.compute_round_metrics(
+            forest, state, picked, vals, scores,
+            higher_is_better=strategy.higher_is_better,
+            n_classes=n_classes,
+        )
+        return new_state, picked, scores, rm
 
     return round_fn
 
@@ -69,11 +91,12 @@ def _accuracy(forest, test_x: jnp.ndarray, test_y: jnp.ndarray) -> jnp.ndarray:
     """Test accuracy on device (``uncertainty_sampling.py:79-83``)."""
     from distributed_active_learning_tpu.ops import trees_multi
 
-    if trees_multi.is_multi(forest):
-        pred = trees_multi.predict_class(forest, test_x)
-    else:
-        pred = (forest_eval.proba(forest, test_x) > 0.5).astype(jnp.int32)
-    return jnp.mean((pred == test_y).astype(jnp.float32))
+    with jax.named_scope("al/eval"):
+        if trees_multi.is_multi(forest):
+            pred = trees_multi.predict_class(forest, test_x)
+        else:
+            pred = (forest_eval.proba(forest, test_x) > 0.5).astype(jnp.int32)
+        return jnp.mean((pred == test_y).astype(jnp.float32))
 
 
 def _labeled_subset(
@@ -148,17 +171,18 @@ def make_device_fit(
 
     @jax.jit
     def fit(codes: jnp.ndarray, state: state_lib.PoolState, key: jax.Array):
-        mask = state.labeled_mask & state.valid_mask
-        c, yy, w = trees_train.gather_fit_window(codes, state.oracle_y, mask, budget)
-        f, th, v = trees_train.fit_forest_device(
-            c, yy, w, edges, key,
-            n_trees=fc.n_trees, max_depth=fc.max_depth, n_bins=fc.max_bins,
-            n_classes=n_classes,
-        )
-        if to_gemm:
-            gf = trees_train.heap_gemm_forest(f, th, v, fc.max_depth)
-            return _wrap_pallas(gf) if fc.kernel == "pallas" else gf
-        return trees_train.heap_packed_forest(f, th, v, fc.max_depth)
+        with jax.named_scope("al/fit"):
+            mask = state.labeled_mask & state.valid_mask
+            c, yy, w = trees_train.gather_fit_window(codes, state.oracle_y, mask, budget)
+            f, th, v = trees_train.fit_forest_device(
+                c, yy, w, edges, key,
+                n_trees=fc.n_trees, max_depth=fc.max_depth, n_bins=fc.max_bins,
+                n_classes=n_classes,
+            )
+            if to_gemm:
+                gf = trees_train.heap_gemm_forest(f, th, v, fc.max_depth)
+                return _wrap_pallas(gf) if fc.kernel == "pallas" else gf
+            return trees_train.heap_packed_forest(f, th, v, fc.max_depth)
 
     return fit
 
@@ -171,6 +195,9 @@ def make_chunk_fn(
     label_cap: int,
     mesh=None,
     wrap_pallas: bool = False,
+    with_metrics: bool = False,
+    n_classes: int = 2,
+    donate: bool = True,
 ):
     """Fuse ``chunk_size`` AL rounds into ONE jitted ``lax.scan`` program.
 
@@ -200,14 +227,29 @@ def make_chunk_fn(
 
     Returns ``chunk_fn(codes, state, aux, fit_key, test_x, test_y,
     end_round) -> (new_state, (rounds, n_labeled, accuracy, picked,
-    active))`` where each y is stacked ``[chunk_size, ...]``; ``n_labeled``
-    is the pre-reveal count (what the evaluated forest was trained on, the
-    reference's print ordering) and ``end_round`` rides as a traced scalar so
-    ``max_rounds`` changes never recompile.
-    """
-    round_fn = make_round_fn(strategy, window_size)
+    active[, metrics]))`` where each y is stacked ``[chunk_size, ...]``;
+    ``n_labeled`` is the pre-reveal count (what the evaluated forest was
+    trained on, the reference's print ordering) and ``end_round`` rides as a
+    traced scalar so ``max_rounds`` changes never recompile. With
+    ``with_metrics`` a stacked :class:`~runtime.telemetry.RoundMetrics`
+    pytree rides as a sixth y — per-round observability for fused runs at
+    the cost of a few extra KB in the touchdown fetch, zero extra syncs.
 
-    @jax.jit
+    ``donate`` donates the carried ``state``'s buffers to the launch
+    (``donate_argnums``): the scan carry aliases the input pool arrays
+    instead of copying them, which matters once pools are HBM-scale. The
+    driver threads each chunk's output state into the next call, so the
+    donated input is never reused — callers that DO reuse a state across
+    calls (benchmarks re-running one launch from a fixed state) must pass
+    ``donate=False``. NOTE the donated ``labeled_mask`` may be aliased by
+    ``aux.seed_mask`` at round 0; the driver copies the seed mask before the
+    first launch for exactly this reason.
+    """
+    round_fn = make_round_fn(
+        strategy, window_size, with_metrics=with_metrics, n_classes=n_classes
+    )
+
+    @functools.partial(jax.jit, donate_argnums=(1,) if donate else ())
     def chunk_fn(
         codes: jnp.ndarray,
         state: state_lib.PoolState,
@@ -235,10 +277,16 @@ def make_chunk_fn(
                     )
 
                     forest = attach_mesh(forest, mesh)
-            new_state, picked, _ = round_fn(forest, carry, aux)
+            if with_metrics:
+                new_state, picked, _, rm = round_fn(forest, carry, aux)
+            else:
+                new_state, picked, _ = round_fn(forest, carry, aux)
             acc = _accuracy(forest, test_x, test_y)
             out = state_lib.select_state(active, new_state, carry)
-            return out, (carry.round + 1, n_labeled, acc, picked, active)
+            ys = (carry.round + 1, n_labeled, acc, picked, active)
+            if with_metrics:
+                ys = ys + (rm,)
+            return out, ys
 
         return jax.lax.scan(body, state, None, length=chunk_size)
 
@@ -262,6 +310,7 @@ def run_experiment(
     cfg: ExperimentConfig,
     bundle: Optional[DataBundle] = None,
     debugger: Optional[Debugger] = None,
+    metrics=None,
 ) -> ExperimentResult:
     """Run a full AL experiment; returns per-round records.
 
@@ -269,10 +318,16 @@ def run_experiment(
     (``uncertainty_sampling.py`` etc.) and the experiment tail of
     ``active_learner.py:369-384``, with the gaps the reference left filled in:
     configurable stopping, structured timing, optional checkpoint/resume.
+
+    ``metrics`` (a :class:`~runtime.telemetry.MetricsWriter`, or None) turns
+    on the structured JSONL event stream — one ``round`` event per AL round
+    (including the device-computed RoundMetrics), launch accounting, transfer
+    counters, and memory gauges — and implies ``cfg.collect_metrics``.
     """
     dbg = debugger or Debugger(enabled=False)
     if bundle is None:
         bundle = get_dataset(cfg.data)
+    want_metrics = metrics is not None or cfg.collect_metrics
 
     test_x = jnp.asarray(bundle.test_x)
     test_y = jnp.asarray(bundle.test_y)
@@ -314,7 +369,10 @@ def run_experiment(
         mesh = make_mesh(data=cfg.mesh.data, model=cfg.mesh.model)
         state = state_lib.pad_for_sharding(state, cfg.mesh.data)
         state = shard_pool_state(state, mesh)
-        round_fn = make_sharded_round_fn(strategy, cfg.strategy.window_size, mesh)
+        round_fn = make_sharded_round_fn(
+            strategy, cfg.strategy.window_size, mesh,
+            with_metrics=want_metrics, n_classes=n_classes,
+        )
         if cfg.forest.kernel == "pallas":
             # pallas_call has no GSPMD partitioning rule, so the fused kernel
             # runs per-shard under shard_map instead (rows over data, trees
@@ -329,10 +387,23 @@ def run_experiment(
         test_x = mesh_lib.global_put(test_x, mesh, mesh_lib.replicated_spec())
         test_y = mesh_lib.global_put(test_y, mesh, mesh_lib.replicated_spec())
     else:
-        round_fn = make_round_fn(strategy, cfg.strategy.window_size)
+        round_fn = make_round_fn(
+            strategy, cfg.strategy.window_size,
+            with_metrics=want_metrics, n_classes=n_classes,
+        )
         place_forest = lambda f: f
 
     aux = build_aux(cfg, state)
+
+    if metrics is not None:
+        from distributed_active_learning_tpu.config import asdict as cfg_asdict
+
+        metrics.meta(
+            config=cfg_asdict(cfg),
+            backend=jax.default_backend(),
+            n_devices=jax.device_count(),
+            process_count=jax.process_count(),
+        )
 
     if cfg.forest.fit not in ("host", "device"):
         raise ValueError(f"unknown ForestConfig.fit {cfg.forest.fit!r}; use 'host' or 'device'")
@@ -388,23 +459,36 @@ def run_experiment(
 
     # Chunked (scan-fused) driver: only when the whole round is device-
     # resident. Host fit needs a host round-trip per round by construction,
-    # and a Debugger asking for per-phase (train/score/eval) wall splits
-    # needs per-program syncs a fused scan cannot attribute — both fall back
-    # to the per-round path below. (Debugger.phase_detail defaults to its
-    # enabled flag; pass phase_detail=False to keep logs AND fuse.)
+    # and a Debugger explicitly asking for per-phase (train/score/eval) wall
+    # splits needs per-program syncs a fused scan cannot attribute — those
+    # two fall back to the per-round path below. A merely-*enabled* Debugger
+    # no longer forces the fallback (the pre-telemetry coupling): fused runs
+    # now regain per-round visibility through the in-scan RoundMetrics and
+    # the touchdown iteration logs, so only phase_detail=True (opt-in) is
+    # genuinely host-bound.
     use_chunked = (
         cfg.rounds_per_launch > 1
         and device_fit is not None
-        and not getattr(dbg, "phase_detail", dbg.enabled)
+        and not getattr(dbg, "phase_detail", False)
     )
     if use_chunked:
+        from distributed_active_learning_tpu.runtime import telemetry
+
         K, window = cfg.rounds_per_launch, cfg.strategy.window_size
         label_cap = n_pool if cfg.label_budget is None else min(cfg.label_budget, n_pool)
         chunk_fn = make_chunk_fn(
             strategy, window, K, device_fit, label_cap,
             mesh=mesh,
             wrap_pallas=(mesh is not None and cfg.forest.kernel == "pallas"),
+            with_metrics=want_metrics,
+            n_classes=n_classes,
         )
+        # The chunk donates the carried state's buffers; at round 0
+        # aux.seed_mask aliases state.labeled_mask, and a donated alias would
+        # be a deleted buffer on the second launch — copy it once up front.
+        if aux.seed_mask is not None:
+            aux = aux.replace(seed_mask=jnp.array(aux.seed_mask, copy=True))
+        launches = telemetry.LaunchTracker(metrics, "chunk_scan", fn=chunk_fn)
         end_round = (
             start_round + cfg.max_rounds
             if cfg.max_rounds is not None
@@ -446,22 +530,29 @@ def run_experiment(
                     "raise ForestConfig.fit_budget or lower rounds_per_launch"
                 )
             t0 = time.perf_counter()
-            state, (rounds_y, labeled_y, acc_y, _picked_y, active_y) = chunk_fn(
-                codes, state, aux, fit_key, test_x, test_y, end_round
-            )
+            out = chunk_fn(codes, state, aux, fit_key, test_x, test_y, end_round)
+            state, ys = out
+            rounds_y, labeled_y, acc_y, _picked_y, active_y = ys[:5]
             # The chunk's ONE host touchdown: fetch the stacked ys, bulk-append
             # records, log, maybe checkpoint.
             active_np = np.asarray(active_y)
             wall = time.perf_counter() - t0
+            launches.record(wall)
             n_active = int(active_np.sum())
             if n_active == 0:
                 break
             rounds_np = np.asarray(rounds_y)[active_np]
             labeled_np = np.asarray(labeled_y)[active_np]
             acc_np = np.asarray(acc_y)[active_np]
+            round_dicts = (
+                telemetry.stacked_metrics_to_dicts(ys[5], active_np)
+                if want_metrics
+                else None
+            )
             result.extend_from_arrays(
                 rounds_np, labeled_np, n_pool - labeled_np, acc_np,
                 total_time=wall / n_active,
+                metrics=round_dicts,
             )
             round_idx = int(rounds_np[-1])
             # Post-reveal count of the last active round: its pre-reveal count
@@ -470,7 +561,32 @@ def run_experiment(
             # pool exhaustion, which also stops), so breaking on the bound
             # never skips a round the per-round driver would have run.
             n_known = min(int(labeled_np[-1]) + window, n_pool)
-            if cfg.log_every:
+            if metrics is not None:
+                # Touchdown accounting: bytes actually fetched to the host
+                # this launch (stacked ys + metrics), then one round event per
+                # active round — the fused run's per-round stream the PR-2
+                # design gave up. Shape*itemsize (.nbytes on the device
+                # arrays) — counting the transfer must not add transfers.
+                fetched = (
+                    active_y.nbytes
+                    + rounds_y.nbytes
+                    + labeled_y.nbytes
+                    + acc_y.nbytes
+                )
+                if want_metrics:
+                    fetched += telemetry.metrics_nbytes(ys[5])
+                metrics.counter("host_transfer_bytes", int(fetched))
+                for i in range(n_active):
+                    metrics.round(
+                        round=int(rounds_np[i]),
+                        n_labeled=int(labeled_np[i]),
+                        accuracy=float(acc_np[i]),
+                        **(round_dicts[i] if round_dicts else {}),
+                    )
+                mem = telemetry.device_memory_gauges()
+                if mem:
+                    metrics.gauges(mem, allgather=True)
+            if cfg.log_every and dbg.enabled:
                 for r, nl, a in zip(rounds_np, labeled_np, acc_np):
                     if int(r) % cfg.log_every == 0:
                         dbg.debug(
@@ -534,12 +650,20 @@ def run_experiment(
         train_time = dbg.records[-1][1]
 
         with dbg.phase("round"):
-            state, picked, _ = round_fn(forest, state, aux)
+            if want_metrics:
+                state, picked, _, rm = round_fn(forest, state, aux)
+            else:
+                state, picked, _ = round_fn(forest, state, aux)
             jax.block_until_ready(picked)
         score_time = dbg.records[-1][1]
         with dbg.phase("eval"):
             acc = float(_accuracy(forest, test_x, test_y))
         eval_time = dbg.records[-1][1]
+        round_dict = None
+        if want_metrics:
+            from distributed_active_learning_tpu.runtime import telemetry
+
+            round_dict = telemetry.metrics_to_dict(rm)
 
         # The record pairs the accuracy with the labeled count the evaluated
         # forest was *trained on* (pre-reveal), matching the reference's print
@@ -554,8 +678,19 @@ def run_experiment(
             score_time=score_time,
             eval_time=eval_time,
             total_time=train_time + score_time + eval_time,
+            metrics=round_dict,
         )
         result.append(rec)
+        if metrics is not None:
+            metrics.round(
+                round=round_idx,
+                n_labeled=n_labeled,
+                accuracy=acc,
+                train_time=train_time,
+                score_time=score_time,
+                eval_time=eval_time,
+                **(round_dict or {}),
+            )
         if cfg.log_every and round_idx % cfg.log_every == 0:
             dbg.debug(
                 f"Iteration {round_idx} -- labeled={n_labeled} accu={acc * 100:.2f}"
@@ -567,6 +702,13 @@ def run_experiment(
                 cfg.checkpoint_dir, state, result,
                 fingerprint=ckpt_fp, kernel=ckpt_kernel,
             )
+
+    if metrics is not None:
+        from distributed_active_learning_tpu.runtime import telemetry
+
+        mem = telemetry.device_memory_gauges()
+        if mem:
+            metrics.gauges(mem, allgather=True)
 
     if cfg.results_path:
         result.save(cfg.results_path, fmt="reference")

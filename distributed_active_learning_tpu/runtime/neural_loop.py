@@ -166,7 +166,14 @@ def run_neural_experiment(
     test_y,
     debugger: Optional[Debugger] = None,
     data_ident: Optional[dict] = None,
+    metrics=None,
 ) -> ExperimentResult:
+    """``metrics`` (a :class:`~runtime.telemetry.MetricsWriter`, or None)
+    streams one rank-tagged ``round`` JSONL event per AL round — counts,
+    accuracy, phase wall times — plus end-of-run device memory gauges; the
+    same sink ``run.py --metrics-out`` feeds on the forest path. The neural
+    loop is per-round by construction (its fit is already one fused jitted
+    scan), so its events are host-emitted, not scan ys."""
     dbg = debugger or Debugger(enabled=False)
     strat = _normalize_deep_name(cfg.strategy)
     if strat not in _deep_names():
@@ -226,6 +233,15 @@ def run_neural_experiment(
                 )
             start_round = int(state.round)
             dbg.debug(f"resumed at round {start_round}")
+
+    if metrics is not None:
+        metrics.meta(
+            config=dataclasses.asdict(cfg),
+            loop="neural",
+            backend=jax.default_backend(),
+            n_devices=jax.device_count(),
+            process_count=jax.process_count(),
+        )
 
     n_pool = state.n_valid  # real rows; mesh padding is never selectable
     round_idx = start_round
@@ -351,6 +367,15 @@ def run_neural_experiment(
                 total_time=train_time + score_time + eval_time,
             )
         )
+        if metrics is not None:
+            metrics.round(
+                round=round_idx,
+                n_labeled=n_labeled,
+                accuracy=acc,
+                train_time=train_time,
+                score_time=score_time,
+                eval_time=eval_time,
+            )
         if (
             cfg.checkpoint_dir
             and cfg.checkpoint_every
@@ -361,4 +386,10 @@ def run_neural_experiment(
             ckpt_lib.save_neural(
                 cfg.checkpoint_dir, state, result, net_state, key, fingerprint=ckpt_fp
             )
+    if metrics is not None:
+        from distributed_active_learning_tpu.runtime import telemetry
+
+        mem = telemetry.device_memory_gauges()
+        if mem:
+            metrics.gauges(mem, allgather=True)
     return result

@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
-from typing import List, Optional
+from typing import Any, Dict, List, Optional
 
 
 @dataclasses.dataclass
@@ -26,6 +26,11 @@ class RoundRecord:
     # acquisition timing is pure (rounds logged before r4 folded it in).
     eval_time: float = 0.0
     total_time: float = 0.0
+    # Device-computed RoundMetrics (runtime/telemetry.py) as plain JSON-ready
+    # values: score min/mean/max/margin, pool entropy, labeled fraction,
+    # picked-class histogram. None when metrics collection is off — the
+    # default, so existing logs/checkpoints round-trip unchanged.
+    metrics: Optional[Dict[str, Any]] = None
 
 
 @dataclasses.dataclass
@@ -42,6 +47,7 @@ class ExperimentResult:
         n_unlabeled,
         accuracy,
         total_time=None,
+        metrics: Optional[List[Dict[str, Any]]] = None,
     ) -> None:
         """Bulk append from stacked per-round arrays — the chunked driver's
         touchdown path (runtime/loop.py ``make_chunk_fn``): one ``lax.scan``
@@ -50,7 +56,10 @@ class ExperimentResult:
         round. ``total_time`` (optional, scalar or per-round) lands in
         ``total_time`` with the per-phase splits zero — phase attribution
         inside a fused scan would need per-round host syncs, exactly what the
-        chunk exists to avoid.
+        chunk exists to avoid. ``metrics`` (optional) is one plain dict per
+        round — the in-scan :class:`~runtime.telemetry.RoundMetrics` already
+        converted by ``telemetry.stacked_metrics_to_dicts``, which rode the
+        same scan ys and so cost no extra sync either.
         """
         n = len(rounds)
         times = total_time
@@ -58,6 +67,11 @@ class ExperimentResult:
             times = [0.0] * n
         elif not hasattr(times, "__len__"):
             times = [float(times)] * n
+        if metrics is not None and len(metrics) != n:
+            raise ValueError(
+                f"{len(metrics)} metric dicts for {n} rounds — the active-row "
+                "filter must be applied to both before appending"
+            )
         for i in range(n):
             self.append(
                 RoundRecord(
@@ -66,6 +80,7 @@ class ExperimentResult:
                     n_unlabeled=int(n_unlabeled[i]),
                     accuracy=float(accuracy[i]),
                     total_time=float(times[i]),
+                    metrics=None if metrics is None else metrics[i],
                 )
             )
 

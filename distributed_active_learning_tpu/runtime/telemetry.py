@@ -1,0 +1,474 @@
+"""Device-resident telemetry: in-scan round metrics + a structured sink.
+
+The reference's entire observability story is ``Debugger.TIMESTAMP`` banners
+and per-round prints redirected into text files (``final_thesis/debugger.py:
+6-27``; ``classes/RESULTS.txt``). Our port inherited that ceiling — and the
+scan-fused driver (runtime/loop.py ``make_chunk_fn``) is fast *because* the
+host never looks inside a chunk, so a fused run used to emit nothing but
+chunk-boundary accuracies. This module restores per-round visibility without
+giving the win back, in three layers:
+
+1. **In-scan device metrics** — :class:`RoundMetrics`, a small pytree computed
+   INSIDE the jitted round (``compute_round_metrics``) and returned as extra
+   ``lax.scan`` ys: selection-score summary (min/mean/max of the picked
+   window, margin to the best unpicked candidate), mean prediction entropy
+   over the pool, the picked-class histogram, and the labeled fraction. The
+   host receives K rounds of metrics in the chunk's ONE touchdown — zero
+   extra syncs. The pool-entropy pass re-evaluates the forest, but inside one
+   XLA program the leaf evaluation is shared with the strategy's own scoring
+   via CSE (same kernel, same operands), so the marginal cost is an
+   elementwise entropy + reductions, not a second forest pass.
+
+2. **Trace attribution** — the hot ops carry ``jax.named_scope`` labels
+   (``al/*`` in runtime/loop.py, ``trees/*`` in ops/trees_train.py,
+   ``forest/*`` in ops/forest_eval.py, ``shard/*`` in parallel/kernels.py,
+   ``neural/*`` in models/neural.py) and host-side phases emit
+   ``jax.profiler.TraceAnnotation`` spans (runtime/debugger.py
+   ``Debugger.phase``), so a ``--profile-dir`` trace (run.py) is
+   phase-attributable in TensorBoard/Perfetto instead of one anonymous blob.
+
+3. **Structured sink** — :class:`MetricsWriter` emits rank-tagged JSONL
+   events (rounds, counters, gauges, launches) behind ``run.py
+   --metrics-out``: compile-vs-execute launch accounting with recompile
+   detection via the jit cache size, host<->device transfer-byte counters at
+   chunk touchdowns, and device memory watermarks from
+   ``Device.memory_stats()`` where the backend reports them. Under multihost
+   only ``is_primary()`` writes; per-host gauges cross through
+   :func:`parallel.multihost.gather_scalar_gauges` (a ``process_allgather``)
+   first, so the one file still shows every host.
+
+``benches/summarize_metrics.py`` turns the JSONL back into the per-phase
+table the reference printed.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+
+# ---------------------------------------------------------------------------
+# Layer 1: in-scan device metrics
+# ---------------------------------------------------------------------------
+
+
+@struct.dataclass
+class RoundMetrics:
+    """Per-round device metrics, cheap enough to ride every scan step.
+
+    All leaves are scalars except ``picked_hist`` (``[n_classes]``), so K
+    rounds of metrics stack into a few KB of scan ys — the host fetches them
+    in the chunk's existing touchdown transfer.
+    """
+
+    score_min: jnp.ndarray    # worst picked score (selection-order sense)
+    score_mean: jnp.ndarray   # mean picked score
+    score_max: jnp.ndarray    # best picked score
+    score_margin: jnp.ndarray  # gap from worst picked to best unpicked candidate
+    pool_entropy: jnp.ndarray  # mean predictive entropy over valid pool rows (bits)
+    labeled_frac: jnp.ndarray  # pre-reveal labeled fraction of the real pool
+    picked_hist: jnp.ndarray  # [n_classes] int32 oracle classes of the window
+
+
+def compute_round_metrics(
+    forest,
+    state,
+    picked: jnp.ndarray,
+    picked_vals: jnp.ndarray,
+    scores: jnp.ndarray,
+    *,
+    higher_is_better: bool,
+    n_classes: int,
+) -> RoundMetrics:
+    """Build :class:`RoundMetrics` inside the jitted round (traced code).
+
+    ``state`` is the PRE-reveal pool state, ``picked``/``picked_vals`` the
+    selected window indices and their scores, ``scores`` the full score
+    vector. Called from ``runtime.loop.make_round_fn`` — the per-round and
+    scan-fused drivers therefore run the SAME program for metrics, which is
+    what makes fused-vs-per-round metric parity bit-exact (pinned in
+    tests/test_telemetry.py).
+    """
+    from distributed_active_learning_tpu.ops import forest_eval, scoring, trees_multi
+    from distributed_active_learning_tpu.runtime import state as state_lib
+
+    with jax.named_scope("al/metrics"):
+        valid = state.valid_mask
+        # Short final windows: when fewer than window_size unlabeled rows
+        # remain, ops/topk.py pads the selection with +/-inf sentinel values
+        # whose indices point at already-labeled rows (reveal treats them as
+        # no-ops). Every statistic below masks to the FINITE picks so the
+        # exhaustion tail yields real numbers, not inf/NaN — which would
+        # poison RoundRecord.metrics and serialize as invalid JSON.
+        finite = jnp.isfinite(picked_vals)
+        n_finite = jnp.maximum(jnp.sum(finite.astype(jnp.int32)), 1)
+        score_min = jnp.min(jnp.where(finite, picked_vals, jnp.inf))
+        score_max = jnp.max(jnp.where(finite, picked_vals, -jnp.inf))
+        score_mean = jnp.sum(jnp.where(finite, picked_vals, 0.0)) / n_finite
+        # Margin to the best unpicked candidate: the score gap across the
+        # selection boundary. Candidates are unlabeled real rows minus the
+        # window just picked; the masked extremum uses the same +/-inf
+        # neutralization as ops/topk.py.
+        remaining = (~state.labeled_mask).at[picked].set(False) & valid
+        if higher_is_better:
+            worst_picked = jnp.min(jnp.where(finite, picked_vals, jnp.inf))
+            best_rest = jnp.max(jnp.where(remaining, scores, -jnp.inf))
+            margin = worst_picked - best_rest
+        else:
+            worst_picked = jnp.max(jnp.where(finite, picked_vals, -jnp.inf))
+            best_rest = jnp.min(jnp.where(remaining, scores, jnp.inf))
+            margin = best_rest - worst_picked
+        # No finite picks / no remaining candidates (pool exhausted mid- or
+        # end-window): report 0 rather than the arithmetic of sentinels.
+        score_min = jnp.where(jnp.isfinite(score_min), score_min, 0.0)
+        score_max = jnp.where(jnp.isfinite(score_max), score_max, 0.0)
+        margin = jnp.where(jnp.isfinite(margin), margin, 0.0)
+
+        # Mean predictive entropy over the pool — the classic AL progress
+        # signal (falling entropy = the learner is running out of points it
+        # is unsure about). Full entropy in bits for both the binary and the
+        # multiclass forest forms.
+        if trees_multi.is_multi(forest):
+            ent = trees_multi.entropy_multi(trees_multi.proba_multi(forest, state.x))
+        else:
+            ent = scoring.full_entropy(forest_eval.proba(forest, state.x))
+        ent_mean = jnp.sum(jnp.where(valid, ent, 0.0)) / state.n_valid
+
+        hist = jnp.sum(
+            jax.nn.one_hot(state.oracle_y[picked], n_classes, dtype=jnp.int32)
+            * finite[:, None].astype(jnp.int32),  # sentinel picks count nothing
+            axis=0,
+        )
+        labeled_frac = (
+            state_lib.labeled_count(state).astype(jnp.float32) / state.n_valid
+        )
+        return RoundMetrics(
+            score_min=score_min.astype(jnp.float32),
+            score_mean=score_mean.astype(jnp.float32),
+            score_max=score_max.astype(jnp.float32),
+            score_margin=margin.astype(jnp.float32),
+            pool_entropy=ent_mean.astype(jnp.float32),
+            labeled_frac=labeled_frac,
+            picked_hist=hist,
+        )
+
+
+# The one source of truth for the metric field names — the dict converters
+# below derive from it, so a field added to RoundMetrics cannot silently miss
+# the records/JSONL. picked_hist is the only vector field (list-valued).
+_METRIC_FIELDS = tuple(f.name for f in RoundMetrics.__dataclass_fields__.values())
+
+
+def _field_to_py(host_rm, name: str, idx=None):
+    leaf = getattr(host_rm, name)
+    if idx is not None:
+        leaf = leaf[idx]
+    if name == "picked_hist":
+        return [int(c) for c in np.asarray(leaf)]
+    return float(leaf)
+
+
+def metrics_to_dict(rm: RoundMetrics) -> Dict[str, Any]:
+    """One round's metrics as plain JSON-serializable Python values.
+
+    ONE host transfer (``jax.device_get`` of the whole pytree), not one per
+    leaf — the per-round driver calls this once per round.
+    """
+    host = jax.device_get(rm)
+    return {name: _field_to_py(host, name) for name in _METRIC_FIELDS}
+
+
+def stacked_metrics_to_dicts(
+    rm_stacked: RoundMetrics, active: np.ndarray
+) -> List[Dict[str, Any]]:
+    """Chunk-touchdown conversion: stacked ``[K, ...]`` scan-ys metrics ->
+    one plain dict per ACTIVE round (inactive tail steps are discarded work,
+    same as their accuracy/picked ys)."""
+    host = jax.device_get(rm_stacked)
+    return [
+        {name: _field_to_py(host, name, i) for name in _METRIC_FIELDS}
+        for i in np.flatnonzero(np.asarray(active))
+    ]
+
+
+def metrics_nbytes(rm_stacked: RoundMetrics) -> int:
+    """Bytes the stacked metrics add to a chunk touchdown transfer.
+
+    Pure shape*itemsize bookkeeping (``.nbytes`` on the arrays as-is) — no
+    host materialization; this feeds the transfer counter, so it must not
+    itself add transfers.
+    """
+    return int(sum(l.nbytes for l in jax.tree_util.tree_leaves(rm_stacked)))
+
+
+# ---------------------------------------------------------------------------
+# Layer 2: trace attribution helpers
+# ---------------------------------------------------------------------------
+
+
+def prepare_profile_dir(log_dir: str) -> str:
+    """Validate a ``--profile-dir`` target BEFORE the run starts.
+
+    ``jax.profiler.start_trace`` fails only when the trace is *written* (at
+    ``stop_trace``, after the whole experiment ran) — so an unwritable
+    directory must be refused up front, not mid-run. Creates the directory
+    and probes writability; raises ``ValueError`` with the underlying OS
+    error otherwise.
+    """
+    import tempfile
+
+    try:
+        os.makedirs(log_dir, exist_ok=True)
+        # mkstemp, not a fixed probe name: under multihost every process
+        # probes the same shared directory concurrently, and a shared name
+        # races (A removes the probe B just created -> spurious failure).
+        fd, probe = tempfile.mkstemp(prefix=".write_probe.", dir=log_dir)
+        os.close(fd)
+        os.remove(probe)
+    except OSError as e:
+        raise ValueError(
+            f"--profile-dir {log_dir!r} is not a writable directory: {e}"
+        ) from e
+    return log_dir
+
+
+@contextlib.contextmanager
+def profile_session(log_dir: Optional[str], validate: bool = True):
+    """``jax.profiler`` trace over a block, with the writability check done
+    eagerly (see :func:`prepare_profile_dir` — ``start_trace`` itself only
+    fails when the trace is flushed, after the run). ``None`` = no-op, so
+    callers can wrap unconditionally; the actual trace is
+    :func:`runtime.debugger.profiler_trace` (dead code from the seed until
+    ``run.py --profile-dir`` wired it here). ``validate=False`` skips the
+    writability probe for callers that already ran it (run.py pre-checks so
+    it can fail as a clean argparse error). Under multihost every process
+    traces into the same directory — the profiler namespaces by host."""
+    if log_dir is None:
+        yield
+        return
+    from distributed_active_learning_tpu.runtime.debugger import profiler_trace
+
+    if validate:
+        prepare_profile_dir(log_dir)
+    with profiler_trace(log_dir):
+        yield
+
+
+def jit_cache_size(fn) -> Optional[int]:
+    """Compiled-executable count of a jitted callable (None if unknowable).
+
+    Growth between two observations of the SAME function means a recompile —
+    a shape/dtype/static-arg changed under the driver, exactly the silent
+    perf cliff launch accounting exists to surface.
+    """
+    try:
+        return int(fn._cache_size())
+    except Exception:
+        return None
+
+
+def device_memory_gauges(prefix: str = "device") -> Dict[str, int]:
+    """HBM watermarks from ``Device.memory_stats()`` when the backend reports
+    them (TPU/GPU do; CPU returns None -> empty dict).
+
+    Aggregated as the MAX over this host's local devices: on a multi-device
+    host the OOM-binding constraint is the worst single device, and reading
+    only device 0 would hide a hot shard on device 3.
+    """
+    per_dev = []
+    for d in jax.local_devices():
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if stats:
+            per_dev.append(stats)
+    if not per_dev:
+        return {}
+    out = {}
+    for key, name in (
+        ("bytes_in_use", f"{prefix}_bytes_in_use"),
+        ("peak_bytes_in_use", f"{prefix}_peak_bytes_in_use"),
+    ):
+        vals = [int(s[key]) for s in per_dev if key in s]
+        if vals:
+            out[name] = max(vals)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Layer 3: structured metrics sink
+# ---------------------------------------------------------------------------
+
+
+class MetricsWriter:
+    """Rank-tagged JSONL event stream.
+
+    One line per event: ``{"ts": <unix s>, "kind": ..., "rank": <process>,
+    ...payload}``. Every process may construct one (the chunked driver calls
+    it symmetrically), but only the primary process holds the file handle —
+    non-primary writers accumulate counters and participate in the collective
+    gauge gather without touching disk.
+
+    The file opens in APPEND mode: a checkpoint-resumed run (`run.py
+    --checkpoint-dir` relaunch with the same ``--metrics-out``) must extend
+    the crashed run's stream, not truncate the very post-mortem record it
+    exists to keep; each resume starts with a fresh ``meta`` event, so
+    consumers can segment runs.
+    """
+
+    def __init__(self, path: str, rank: Optional[int] = None):
+        self.path = path
+        self.rank = jax.process_index() if rank is None else rank
+        self.counters: Dict[str, float] = {}
+        self._f = None
+        if self._is_primary():
+            parent = os.path.dirname(os.path.abspath(path))
+            os.makedirs(parent, exist_ok=True)
+            self._f = open(path, "a")
+
+    def _is_primary(self) -> bool:
+        return self.rank == 0
+
+    @staticmethod
+    def _json_safe(v):
+        """Strict-JSON floats: ``json.dumps`` would happily emit bare
+        ``NaN``/``Infinity`` tokens (allow_nan defaults True), which jq and
+        every non-Python consumer reject — map non-finite values to None."""
+        if isinstance(v, float) and not np.isfinite(v):
+            return None
+        if isinstance(v, list):
+            return [MetricsWriter._json_safe(x) for x in v]
+        if isinstance(v, dict):
+            return {k: MetricsWriter._json_safe(x) for k, x in v.items()}
+        return v
+
+    def event(self, kind: str, **fields) -> None:
+        if self._f is None:
+            return
+        line = {"ts": round(time.time(), 3), "kind": kind, "rank": self.rank}
+        line.update(fields)
+        self._f.write(json.dumps(self._json_safe(line)) + "\n")
+        # Flush per event: the stream's whole point is post-mortem visibility,
+        # and a SIGKILLed/preempted run never reaches close() — event volume
+        # is host-side and low (a handful per touchdown), so this is cheap.
+        self._f.flush()
+
+    # -- the event vocabulary ------------------------------------------------
+
+    def meta(self, **fields) -> None:
+        """Run-identity header (config, backend, mesh) — first line."""
+        self.event("meta", **fields)
+
+    def round(self, **fields) -> None:
+        """One AL round: counts, accuracy, phase times, RoundMetrics."""
+        self.event("round", **fields)
+
+    def counter(self, name: str, value: float) -> None:
+        """Monotonic counter increment; the event carries the running total
+        so a truncated stream still reads absolutely."""
+        self.counters[name] = self.counters.get(name, 0.0) + value
+        self.event("counter", name=name, value=value, total=self.counters[name])
+
+    def gauge(self, name: str, value) -> None:
+        self.event("gauge", name=name, value=value)
+
+    def gauges(self, values: Dict[str, float], allgather: bool = False) -> None:
+        """Emit a dict of gauges. With ``allgather=True`` the values cross a
+        ``process_allgather`` first (COLLECTIVE — every process must call),
+        and the primary writes one event per gauge carrying the per-host
+        vector; single-process runs degrade to plain gauges."""
+        if allgather and jax.process_count() > 1:
+            from distributed_active_learning_tpu.parallel.multihost import (
+                gather_scalar_gauges,
+            )
+
+            per_host = gather_scalar_gauges(values)
+            for name, vec in per_host.items():
+                self.event("gauge", name=name, value=sum(vec), per_host=vec)
+            return
+        for name, value in values.items():
+            self.gauge(name, value)
+
+    def launch(
+        self,
+        program: str,
+        seconds: float,
+        first_call: bool,
+        cache_size: Optional[int] = None,
+        recompiled: bool = False,
+    ) -> None:
+        """Launch accounting: the first call of a jitted program includes
+        tracing + XLA compile, so its wall time is reported separately from
+        steady-state executes; ``recompiled`` flags jit-cache growth on a
+        non-first call (the silent recompile cliff)."""
+        self.event(
+            "launch",
+            program=program,
+            seconds=round(seconds, 6),
+            first_call=first_call,
+            cache_size=cache_size,
+            recompiled=recompiled,
+        )
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def flush(self) -> None:
+        if self._f is not None:
+            self._f.flush()
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.flush()
+            self._f.close()
+            self._f = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class LaunchTracker:
+    """Per-program compile-vs-execute split + recompile detection.
+
+    Wraps the touchdown bookkeeping the chunked driver does around its one
+    jitted program: remember whether the program has launched before and the
+    last observed jit-cache size, and emit one ``launch`` event per call.
+    """
+
+    def __init__(self, writer: Optional[MetricsWriter], program: str, fn=None):
+        self.writer = writer
+        self.program = program
+        self.fn = fn
+        self.calls = 0
+        self._last_cache = None
+
+    def record(self, seconds: float) -> None:
+        self.calls += 1
+        if self.writer is None:
+            return
+        cache = jit_cache_size(self.fn) if self.fn is not None else None
+        recompiled = (
+            self.calls > 1
+            and cache is not None
+            and self._last_cache is not None
+            and cache > self._last_cache
+        )
+        self._last_cache = cache
+        self.writer.launch(
+            self.program,
+            seconds,
+            first_call=self.calls == 1,
+            cache_size=cache,
+            recompiled=recompiled,
+        )

@@ -161,7 +161,13 @@ def test_chunk_fn_picked_and_mask_match_manual_rounds():
     fit_key = jax.random.key(cfg.seed + 0x5EED)
     tx, ty = jnp.asarray(bundle.test_x), jnp.asarray(bundle.test_y)
 
-    chunk_fn = make_chunk_fn(strategy, window, K, device_fit, label_cap=state0.n_valid)
+    # donate=False: this test steps the SAME state0 through the manual
+    # per-round loop after the chunk call — the driver's donation (covered by
+    # test_chunked_driver_donates_without_warnings) would leave state0's
+    # buffers deleted here.
+    chunk_fn = make_chunk_fn(
+        strategy, window, K, device_fit, label_cap=state0.n_valid, donate=False
+    )
     end_round = jnp.int32(np.iinfo(np.int32).max)
     chunk_state, (rounds_y, labeled_y, _acc_y, picked_y, active_y) = chunk_fn(
         binned.codes, state0, aux, fit_key, tx, ty, end_round
@@ -182,6 +188,49 @@ def test_chunk_fn_picked_and_mask_match_manual_rounds():
     np.testing.assert_array_equal(
         jax.random.key_data(chunk_state.key), jax.random.key_data(st.key)
     )
+
+
+def test_chunked_driver_donates_without_warnings():
+    """The chunk launch donates the carried PoolState buffers
+    (ROADMAP PR-2 follow-up). Every buffer must actually alias an output —
+    an unusable donation surfaces as a jax warning, and aliasing
+    ``aux.seed_mask`` with the donated mask would surface as a deleted-buffer
+    error on the second launch (the driver copies the seed mask for exactly
+    that reason). Multiple launches + a run long enough to cross chunk
+    boundaries exercise both."""
+    import warnings
+
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        chunked = run_experiment(_cfg(3, max_rounds=7))
+    assert len(chunked.records) == 7  # 3 launches: rounds 1-3, 4-6, 7
+    donation_warnings = [
+        str(w.message) for w in caught if "donat" in str(w.message).lower()
+    ]
+    assert donation_warnings == []
+
+
+def test_chunked_enabled_debugger_no_longer_falls_back():
+    """Pre-telemetry, an enabled Debugger (phase_detail defaulted to
+    enabled) silently cost every logged run its scan fusion. Now only an
+    explicit phase_detail=True does; a merely-enabled debugger keeps the
+    chunked driver (zero per-round phase splits) with identical records."""
+    from distributed_active_learning_tpu.runtime.debugger import Debugger
+
+    base = run_experiment(_cfg(1))
+    fused = run_experiment(
+        _cfg(4), debugger=Debugger(enabled=True, printer=lambda *a: None)
+    )
+    _assert_records_equal(fused, base)
+    assert all(r.train_time == 0 for r in fused.records)  # chunked engaged
+    detailed = run_experiment(
+        _cfg(4),
+        debugger=Debugger(
+            enabled=True, printer=lambda *a: None, phase_detail=True
+        ),
+    )
+    _assert_records_equal(detailed, base)
+    assert all(r.train_time > 0 for r in detailed.records)  # fell back
 
 
 def test_chunked_driver_on_sharded_mesh(devices):

@@ -201,6 +201,90 @@ def test_cli_batchbald_flags_and_truncation_log(capsys):
     assert "candidate pool truncated to top 32" in captured.out + captured.err
 
 
+def test_cli_quiet_chunked_is_zero_overhead_fast_path(capsys, monkeypatch):
+    """--quiet --rounds-per-launch K must engage the chunked driver (no
+    per-round fallback: zero phase splits in the records) with NO printer
+    calls at all — the pre-telemetry run.py built an enabled Debugger whose
+    phase_detail default silently forced the per-round path."""
+    from distributed_active_learning_tpu.runtime import debugger as dbg_mod
+
+    calls = []
+    monkeypatch.setattr(dbg_mod.Debugger, "debug", lambda self, *a: calls.append(a))
+    rc = main([
+        "--dataset", "checkerboard2x2", "--strategy", "uncertainty",
+        "--window", "25", "--rounds", "4", "--quiet", "--json",
+        "--fit", "device", "--rounds-per-launch", "2",
+    ])
+    assert rc == 0
+    lines = [json.loads(l) for l in capsys.readouterr().out.strip().splitlines()]
+    assert len(lines) == 4
+    assert all(r["train_time"] == 0 for r in lines)  # chunked driver engaged
+    assert calls == []  # zero printer traffic
+
+
+def test_cli_phase_detail_forces_per_round(capsys):
+    """--phase-detail is the explicit opt-in that trades scan fusion for
+    host-timed train/round/eval splits."""
+    rc = main([
+        "--dataset", "checkerboard2x2", "--strategy", "uncertainty",
+        "--window", "25", "--rounds", "2", "--quiet", "--json",
+        "--fit", "device", "--rounds-per-launch", "2", "--phase-detail",
+    ])
+    assert rc == 0
+    lines = [json.loads(l) for l in capsys.readouterr().out.strip().splitlines()]
+    assert len(lines) == 2
+    assert all(r["train_time"] > 0 for r in lines)  # per-round fallback
+
+
+def test_cli_metrics_out_fused_run(capsys, tmp_path):
+    """--metrics-out on a fused run: one JSONL round event per AL round with
+    the device-computed metrics attached, chunked driver kept (acceptance
+    criterion of the telemetry PR)."""
+    path = str(tmp_path / "m.jsonl")
+    rc = main([
+        "--dataset", "checkerboard2x2", "--strategy", "uncertainty",
+        "--window", "20", "--rounds", "4", "--quiet", "--json",
+        "--fit", "device", "--rounds-per-launch", "8",
+        "--metrics-out", path,
+    ])
+    assert rc == 0
+    records = [json.loads(l) for l in capsys.readouterr().out.strip().splitlines()]
+    assert all(r["train_time"] == 0 for r in records)  # no per-round fallback
+    assert all(r["metrics"] is not None for r in records)
+    events = [json.loads(l) for l in open(path)]
+    rounds = [e for e in events if e["kind"] == "round"]
+    assert [e["round"] for e in rounds] == [1, 2, 3, 4]
+    assert all("pool_entropy" in e and "picked_hist" in e for e in rounds)
+
+
+def test_cli_profile_dir_unwritable_errors_before_run(tmp_path):
+    """An unwritable --profile-dir must be refused up front (argparse error),
+    not after the experiment ran and the trace flush fails."""
+    blocker = tmp_path / "file"
+    blocker.write_text("x")
+    with pytest.raises(SystemExit):
+        main([
+            "--dataset", "checkerboard2x2", "--strategy", "random",
+            "--rounds", "1", "--quiet",
+            "--profile-dir", str(blocker / "trace"),
+        ])
+
+
+def test_cli_profile_dir_writes_trace(tmp_path):
+    """--profile-dir reaches profiler_trace (dead code from the seed until
+    this PR) on the forest path and leaves trace artifacts."""
+    import os
+
+    d = str(tmp_path / "trace")
+    rc = main([
+        "--dataset", "checkerboard2x2", "--strategy", "random",
+        "--window", "30", "--rounds", "1", "--quiet",
+        "--profile-dir", d,
+    ])
+    assert rc == 0
+    assert sum(len(f) for _, _, f in os.walk(d)) > 0
+
+
 def test_cli_plot_writes_png(tmp_path):
     out = tmp_path / "curve.png"
     rc = main([

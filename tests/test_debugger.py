@@ -1,0 +1,103 @@
+"""Direct coverage for runtime/debugger.py — the reference's TIMESTAMP
+tracer made structured. Had zero direct tests before the telemetry PR,
+despite the per-round driver's phase timings (and the chunked driver's
+fallback decision) both riding on it."""
+
+import time
+
+import pytest
+
+from distributed_active_learning_tpu.runtime.debugger import Debugger, profiler_trace
+
+
+def _capture():
+    lines = []
+
+    def printer(*args):
+        lines.append(" ".join(str(a) for a in args))
+
+    return lines, printer
+
+
+def test_timestamp_records_and_prints():
+    lines, printer = _capture()
+    dbg = Debugger(enabled=True, printer=printer)
+    elapsed = dbg.timestamp("load")
+    assert elapsed >= 0.0
+    assert dbg.records == [("load", elapsed)]
+    assert len(lines) == 1 and "[load]" in lines[0] and "total" in lines[0]
+    # Second timestamp measures from the previous one (the reference's
+    # phase-reset semantics, final_thesis/debugger.py:15-27).
+    time.sleep(0.01)
+    e2 = dbg.timestamp("train")
+    assert e2 >= 0.01
+    assert [l for l, _ in dbg.records] == ["load", "train"]
+
+
+def test_timestamp_disabled_still_records():
+    lines, printer = _capture()
+    dbg = Debugger(enabled=False, printer=printer)
+    dbg.timestamp("x")
+    dbg.debug("y")
+    assert lines == []  # no printer calls when disabled...
+    assert len(dbg.records) == 1  # ...but structured records still accrue
+
+
+def test_phase_nesting():
+    lines, printer = _capture()
+    dbg = Debugger(enabled=True, printer=printer)
+    with dbg.phase("outer"):
+        with dbg.phase("inner"):
+            time.sleep(0.01)
+    # Inner closes first; outer's elapsed includes inner's.
+    assert [l for l, _ in dbg.records] == ["inner", "outer"]
+    times = dict(dbg.records)
+    assert times["outer"] >= times["inner"] >= 0.01
+    assert any("[inner]" in l for l in lines) and any("[outer]" in l for l in lines)
+
+
+def test_phase_records_on_exception():
+    dbg = Debugger(enabled=False)
+    with pytest.raises(RuntimeError):
+        with dbg.phase("boom"):
+            raise RuntimeError("x")
+    assert [l for l, _ in dbg.records] == ["boom"]
+
+
+def test_totals_aggregate_per_label():
+    dbg = Debugger(enabled=False)
+    for _ in range(3):
+        with dbg.phase("train"):
+            pass
+        with dbg.phase("eval"):
+            pass
+    totals = dbg.totals()
+    assert set(totals) == {"train", "eval"}
+    assert totals["train"] == pytest.approx(
+        sum(e for l, e in dbg.records if l == "train")
+    )
+    assert dbg.total_time() >= 0.0
+
+
+def test_phase_detail_defaults_false():
+    """The fallback-coupling fix: an enabled Debugger must NOT imply
+    phase_detail anymore — per-round visibility in fused runs comes from the
+    in-scan RoundMetrics, so phase timing is an explicit opt-in."""
+    assert Debugger(enabled=True).phase_detail is False
+    assert Debugger(enabled=False).phase_detail is False
+    assert Debugger(enabled=True, phase_detail=True).phase_detail is True
+    assert Debugger(enabled=False, phase_detail=True).phase_detail is True
+
+
+def test_debug_respects_enabled():
+    lines, printer = _capture()
+    Debugger(enabled=True, printer=printer).debug("hello", 42)
+    assert lines == ["[DEBUG] hello 42"]
+    lines2, printer2 = _capture()
+    Debugger(enabled=False, printer=printer2).debug("hello")
+    assert lines2 == []
+
+
+def test_profiler_trace_none_is_noop():
+    with profiler_trace(None):
+        pass  # must not touch jax at all

@@ -1,0 +1,322 @@
+"""Device-resident telemetry (runtime/telemetry.py): in-scan RoundMetrics
+parity, the MetricsWriter JSONL sink, profiler-session plumbing, and the
+summarizer.
+
+The load-bearing property: fused-scan metrics must be BIT-IDENTICAL to the
+per-round driver's — both run the same jitted metrics program
+(loop.make_round_fn), so enabling observability can never change what it
+observes.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from distributed_active_learning_tpu.config import (
+    DataConfig,
+    ExperimentConfig,
+    ForestConfig,
+    MeshConfig,
+    StrategyConfig,
+)
+from distributed_active_learning_tpu.runtime import telemetry
+from distributed_active_learning_tpu.runtime.loop import run_experiment
+
+METRIC_KEYS = {
+    "score_min", "score_mean", "score_max", "score_margin",
+    "pool_entropy", "labeled_frac", "picked_hist",
+}
+
+
+def _cfg(rounds_per_launch, strategy="uncertainty", **kw):
+    return ExperimentConfig(
+        data=DataConfig(name="checkerboard2x2", seed=3),
+        forest=kw.pop("forest", ForestConfig(n_trees=10, max_depth=4, fit="device")),
+        strategy=StrategyConfig(name=strategy, window_size=20),
+        n_start=10,
+        max_rounds=kw.pop("max_rounds", 5),
+        seed=kw.pop("seed", 0),
+        rounds_per_launch=rounds_per_launch,
+        collect_metrics=True,
+        **kw,
+    )
+
+
+def _assert_metrics_equal(a, b):
+    """Bit-identical metric dicts across two runs (same jitted program)."""
+    assert len(a.records) == len(b.records)
+    for ra, rb in zip(a.records, b.records):
+        assert ra.metrics is not None and rb.metrics is not None
+        assert set(ra.metrics) == METRIC_KEYS
+        assert ra.metrics == rb.metrics, f"round {ra.round}: {ra.metrics} != {rb.metrics}"
+
+
+@pytest.mark.parametrize("strategy", ["uncertainty", "density"])
+def test_round_metrics_parity_fused_vs_per_round(strategy):
+    """The acceptance bar: per-round RoundMetrics from the fused driver are
+    bit-identical to the per-round driver's (both call the same round_fn)."""
+    base = run_experiment(_cfg(1, strategy=strategy))
+    fused = run_experiment(_cfg(4, strategy=strategy))
+    _assert_metrics_equal(fused, base)
+
+
+def test_round_metrics_parity_on_sharded_mesh(devices):
+    """Same parity on the 4x2 mesh: the metrics reductions are plain jnp ops,
+    so GSPMD partitions them with the round — chunked-on-mesh must equal
+    per-round-on-mesh exactly."""
+
+    def cfg(k):
+        return ExperimentConfig(
+            data=DataConfig(name="checkerboard2x2", n_samples=250, seed=2),
+            forest=ForestConfig(n_trees=8, max_depth=4, fit="device", kernel="pallas"),
+            strategy=StrategyConfig(name="uncertainty", window_size=10),
+            mesh=MeshConfig(data=4, model=2),
+            n_start=10,
+            max_rounds=4,
+            seed=7,
+            rounds_per_launch=k,
+            collect_metrics=True,
+        )
+
+    base = run_experiment(cfg(1))
+    fused = run_experiment(cfg(4))
+    _assert_metrics_equal(fused, base)
+
+
+def test_round_metrics_values_sane():
+    """Semantic floor for each metric: histogram counts the window, labeled
+    fraction tracks the curve, entropy is a valid bit count, and the
+    selection margin to the best unpicked candidate is non-negative (top-k
+    boundary by construction)."""
+    res = run_experiment(_cfg(2))
+    window = 20
+    for rec in res.records:
+        m = rec.metrics
+        n_pool = rec.n_labeled + rec.n_unlabeled
+        assert sum(m["picked_hist"]) == window
+        assert m["labeled_frac"] == pytest.approx(rec.n_labeled / n_pool)
+        assert 0.0 <= m["pool_entropy"] <= 1.0 + 1e-6  # binary: <= 1 bit
+        assert m["score_min"] <= m["score_mean"] <= m["score_max"]
+        assert m["score_margin"] >= 0.0
+
+
+def test_round_metrics_finite_on_pool_exhaustion_tail(tmp_path):
+    """The final window can overrun the remaining unlabeled pool (topk pads
+    the selection with +/-inf sentinels): metrics must mask to the finite
+    picks — no inf/NaN in records, the histogram counting only real reveals,
+    and the JSONL staying STRICT json (no bare NaN/Infinity tokens)."""
+    path = str(tmp_path / "m.jsonl")
+    writer = telemetry.MetricsWriter(path)
+    cfg = ExperimentConfig(
+        data=DataConfig(name="checkerboard2x2", n_samples=45, seed=3),
+        forest=ForestConfig(n_trees=8, max_depth=4, fit="device"),
+        strategy=StrategyConfig(name="uncertainty", window_size=20),
+        n_start=10,  # r1: 10->30, r2: only 15 unlabeled left for a 20-window
+        max_rounds=4,
+        rounds_per_launch=2,
+    )
+    res = run_experiment(cfg, metrics=writer)
+    writer.close()
+    assert res.records[-1].n_labeled == 30  # the short tail round ran
+    tail = res.records[-1].metrics
+    assert all(np.isfinite(v) for k, v in tail.items() if k != "picked_hist")
+    assert sum(tail["picked_hist"]) == 15  # sentinel picks count nothing
+
+    def _no_const(s):  # json emitting NaN/Infinity would call parse_constant
+        raise AssertionError(f"non-strict JSON token {s!r} in metrics stream")
+
+    for line in open(path):
+        json.loads(line, parse_constant=_no_const)
+
+
+def test_metrics_off_by_default():
+    cfg = ExperimentConfig(
+        data=DataConfig(name="checkerboard2x2", seed=3),
+        forest=ForestConfig(n_trees=10, max_depth=4, fit="device"),
+        strategy=StrategyConfig(name="uncertainty", window_size=20),
+        n_start=10, max_rounds=2,
+    )
+    res = run_experiment(cfg)
+    assert all(r.metrics is None for r in res.records)
+
+
+def test_metrics_writer_jsonl_stream(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    with telemetry.MetricsWriter(path) as w:
+        w.meta(config={"x": 1}, backend="cpu")
+        w.round(round=1, n_labeled=10, accuracy=0.5)
+        w.counter("host_transfer_bytes", 100)
+        w.counter("host_transfer_bytes", 50)
+        w.gauge("device_peak_bytes_in_use", 123)
+        w.launch("chunk_scan", 0.5, first_call=True, cache_size=1)
+        w.launch("chunk_scan", 0.1, first_call=False, cache_size=2, recompiled=True)
+    events = [json.loads(l) for l in open(path)]
+    kinds = [e["kind"] for e in events]
+    assert kinds == ["meta", "round", "counter", "counter", "gauge", "launch", "launch"]
+    assert all(e["rank"] == 0 and "ts" in e for e in events)
+    assert events[3]["total"] == 150  # counters carry running totals
+    assert events[-1]["recompiled"] is True
+    # A second writer on the same path APPENDS (checkpoint-resume must not
+    # truncate the crashed run's stream); the fresh meta event segments runs.
+    with telemetry.MetricsWriter(path) as w2:
+        w2.meta(resumed=True)
+    events2 = [json.loads(l) for l in open(path)]
+    assert len(events2) == len(events) + 1 and events2[-1]["resumed"] is True
+
+
+def test_metrics_writer_non_primary_writes_nothing(tmp_path):
+    path = str(tmp_path / "rank1.jsonl")
+    w = telemetry.MetricsWriter(path, rank=1)
+    w.round(round=1, n_labeled=10, accuracy=0.5)
+    w.counter("c", 1)  # still accumulates (symmetric with primary)
+    w.close()
+    assert not os.path.exists(path)
+    assert w.counters == {"c": 1}
+
+
+def test_fused_run_emits_one_round_event_per_round(tmp_path):
+    """A fused run with a writer stays on the chunked driver (no per-round
+    fallback — zero phase splits) while emitting one 'round' JSONL event per
+    round, with the in-scan metrics attached."""
+    path = str(tmp_path / "m.jsonl")
+    writer = telemetry.MetricsWriter(path)
+    cfg = _cfg(4, max_rounds=6)
+    res = run_experiment(cfg, metrics=writer)
+    writer.close()
+    assert len(res.records) == 6
+    assert all(r.train_time == 0 for r in res.records)  # chunked engaged
+    events = [json.loads(l) for l in open(path)]
+    rounds = [e for e in events if e["kind"] == "round"]
+    assert [e["round"] for e in rounds] == [r.round for r in res.records]
+    assert all(METRIC_KEYS <= set(e) for e in rounds)
+    # Launch accounting: one event per chunk launch, the first marked as the
+    # compile call; transfer counters rode the touchdowns.
+    launches = [e for e in events if e["kind"] == "launch"]
+    assert len(launches) >= 2 and launches[0]["first_call"]
+    assert not any(l["recompiled"] for l in launches)  # static shapes: 1 compile
+    assert any(
+        e["kind"] == "counter" and e["name"] == "host_transfer_bytes"
+        for e in events
+    )
+    assert events[0]["kind"] == "meta" and events[0]["backend"] == "cpu"
+
+
+def test_per_round_driver_round_events_carry_phases(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    writer = telemetry.MetricsWriter(path)
+    run_experiment(_cfg(1, max_rounds=3), metrics=writer)
+    writer.close()
+    rounds = [json.loads(l) for l in open(path) if '"round"' in l]
+    rounds = [e for e in rounds if e["kind"] == "round"]
+    assert len(rounds) == 3
+    assert all(e["train_time"] > 0 and e["eval_time"] > 0 for e in rounds)
+
+
+def test_metrics_survive_checkpoint_roundtrip(tmp_path):
+    """RoundRecord.metrics rides the records_json checkpoint payload — a
+    resumed run keeps the metrics of already-completed rounds."""
+    ckpt = str(tmp_path / "ckpt")
+    forest = ForestConfig(n_trees=10, max_depth=4, fit="device", fit_budget=256)
+    cfg = _cfg(3, forest=forest, max_rounds=4, seed=4,
+               checkpoint_dir=ckpt, checkpoint_every=1)
+    first = run_experiment(cfg)
+    resumed = run_experiment(_cfg(
+        3, forest=forest, max_rounds=4, seed=4,
+        checkpoint_dir=ckpt, checkpoint_every=1,
+    ))
+    assert [r.metrics for r in resumed.records[:4]] == [
+        r.metrics for r in first.records
+    ]
+
+
+def test_neural_loop_round_events(tmp_path):
+    from distributed_active_learning_tpu.run import main
+
+    path = str(tmp_path / "m.jsonl")
+    rc = main([
+        "--dataset", "checkerboard2x2", "--strategy", "deep.bald",
+        "--window", "10", "--rounds", "2", "--quiet", "--json",
+        "--train-steps", "10", "--mc-samples", "3", "--hidden", "8",
+        "--metrics-out", path,
+    ])
+    assert rc == 0
+    events = [json.loads(l) for l in open(path)]
+    assert [e["kind"] for e in events][:1] == ["meta"]
+    assert sum(e["kind"] == "round" for e in events) == 2
+
+
+def test_profile_session_writes_trace(tmp_path):
+    """--profile-dir plumbing: profiler_trace (dead code until this PR) runs
+    and leaves trace artifacts behind."""
+    import jax
+    import jax.numpy as jnp
+
+    d = str(tmp_path / "trace")
+    with telemetry.profile_session(d):
+        jax.block_until_ready(jnp.ones((8, 8)) @ jnp.ones((8, 8)))
+    n_files = sum(len(files) for _, _, files in os.walk(d))
+    assert n_files > 0
+
+
+def test_profile_session_unwritable_dir_fails_before_run(tmp_path):
+    blocker = tmp_path / "blocker"
+    blocker.write_text("not a directory")
+    with pytest.raises(ValueError, match="not a writable directory"):
+        telemetry.prepare_profile_dir(str(blocker / "trace"))
+
+
+def test_gather_scalar_gauges_single_process():
+    from distributed_active_learning_tpu.parallel.multihost import (
+        gather_scalar_gauges,
+    )
+
+    assert gather_scalar_gauges({"a": 1.5, "b": 2}) == {"a": [1.5], "b": [2.0]}
+
+
+def test_summarize_metrics_tables(tmp_path, capsys):
+    """benches/summarize_metrics.py rebuilds the reference's per-phase table
+    from a real run's JSONL."""
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "benches"))
+    try:
+        import summarize_metrics
+    finally:
+        sys.path.pop(0)
+
+    path = str(tmp_path / "m.jsonl")
+    writer = telemetry.MetricsWriter(path)
+    run_experiment(_cfg(1, max_rounds=3), metrics=writer)
+    writer.close()
+    assert summarize_metrics.main([path]) == 0
+    out = capsys.readouterr().out
+    assert "== rounds ==" in out
+    assert "== phases ==" in out and "train" in out and "eval" in out
+    assert "pool entropy" in out
+
+    # Fused run: launch accounting section appears instead of phases.
+    path2 = str(tmp_path / "m2.jsonl")
+    writer2 = telemetry.MetricsWriter(path2)
+    run_experiment(_cfg(3, max_rounds=3), metrics=writer2)
+    writer2.close()
+    assert summarize_metrics.main([path2]) == 0
+    out2 = capsys.readouterr().out
+    assert "== launches ==" in out2 and "chunk_scan" in out2
+    assert "== counters ==" in out2 and "host_transfer_bytes" in out2
+
+
+def test_jit_cache_size_reports_growth():
+    import jax
+
+    f = jax.jit(lambda x: x + 1)
+    assert telemetry.jit_cache_size(f) in (0, None)
+    import jax.numpy as jnp
+
+    f(jnp.ones(4))
+    assert telemetry.jit_cache_size(f) == 1
+    f(jnp.ones(8))  # new shape -> recompile
+    assert telemetry.jit_cache_size(f) == 2
+    assert telemetry.jit_cache_size(object()) is None
