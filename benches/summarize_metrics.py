@@ -112,6 +112,12 @@ def summarize(events: List[dict]) -> str:
             evs = [e for e in launches if e["program"] == program]
             first = next((e for e in evs if e.get("first_call")), None)
             steady = [e["seconds"] for e in evs if not e.get("first_call")]
+            # Pipelined-driver overlap accounting (runtime/pipeline.py): how
+            # much of the per-chunk host touchdown ran hidden under another
+            # chunk's execution. Absent pre-pipeline streams show "-".
+            td = [e["touchdown_seconds"] for e in evs if "touchdown_seconds" in e]
+            ov = [e["overlap_seconds"] for e in evs if "overlap_seconds" in e]
+            hidden = f"{sum(ov) / sum(td):.0%}" if td and sum(td) > 0 else "-"
             rows.append(
                 [
                     program,
@@ -119,14 +125,26 @@ def summarize(events: List[dict]) -> str:
                     f"{first['seconds']:.3f}" if first else "-",
                     f"{sum(steady) / len(steady):.4f}" if steady else "-",
                     sum(1 for e in evs if e.get("recompiled")),
+                    f"{sum(td):.4f}" if td else "-",
+                    hidden,
                 ]
             )
         out.append(
             "\n== launches ==\n"
             + _table(
-                ["program", "calls", "first (compile) s", "steady mean s", "recompiles"],
+                ["program", "calls", "first (compile) s", "steady mean s",
+                 "recompiles", "touchdown s", "hidden"],
                 rows,
             )
+        )
+
+    streamed = [e for e in events if e.get("kind") == "round_stream"]
+    if streamed:
+        out.append(
+            f"\n== round_stream ==\n{len(streamed)} in-scan round events "
+            f"(rounds {min(e['round'] for e in streamed)}.."
+            f"{max(e['round'] for e in streamed)}; emitted live from inside "
+            "running chunks via --stream-rounds)"
         )
 
     if counters:

@@ -139,6 +139,21 @@ class ExperimentConfig:
     # per-round driver. Silently falls back to the per-round path for host
     # fit or when a Debugger wants per-phase timings (runtime/loop.py).
     rounds_per_launch: int = 1
+    # Chunk launches allowed in flight at once (runtime/pipeline.py): with
+    # the default 2 the driver dispatches chunk N+1 from device-resident
+    # state before chunk N's host touchdown (record append / logging /
+    # checkpoint) runs, hiding the touchdown behind device execution; one
+    # speculative chunk may overrun the stop point as masked no-ops, so
+    # results stay bit-identical to depth 1 (today's strict serial order,
+    # the exact fallback used for host fit / --phase-detail). Performance-
+    # only, like rounds_per_launch; takes effect when rounds_per_launch > 1.
+    pipeline_depth: int = 2
+    # Stream per-round events to the MetricsWriter from INSIDE a running
+    # chunk via jax.debug.callback ("round_stream" JSONL events), instead of
+    # only at chunk touchdowns. Off by default: the flag adds a host callback
+    # to the traced chunk program, and the zero-overhead fast path must stay
+    # untouched unless explicitly asked for.
+    stream_round_events: bool = False
     seed: int = 0
     # Observability
     # Compute per-round RoundMetrics (runtime/telemetry.py) on device and

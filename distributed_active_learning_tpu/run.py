@@ -64,9 +64,19 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--budget", type=int, default=None, help="stop at N labeled")
     ap.add_argument(
         "--rounds-per-launch", type=int, default=1,
-        help="with --fit device: fuse this many AL rounds into one jitted "
-        "lax.scan launch (host touches down only at chunk boundaries; "
-        "results identical, stopping exact). 1 = per-round driver",
+        help="fuse this many AL rounds into one jitted lax.scan launch (host "
+        "touches down only at chunk boundaries; results identical, stopping "
+        "exact). Applies to --fit device on the forest path and to the "
+        "fusable deep strategies (MC-score family/random/density) on the "
+        "neural path. 1 = per-round driver",
+    )
+    ap.add_argument(
+        "--pipeline-depth", type=int, default=2,
+        help="chunk launches allowed in flight at once (with "
+        "--rounds-per-launch > 1): 2 overlaps each chunk's host touchdown "
+        "(record append/log/checkpoint) with the next chunk's device "
+        "execution — results stay bit-identical; 1 = strict serial "
+        "launch -> block -> touchdown order",
     )
     ap.add_argument("--seed", type=int, default=0)
     # Observability (runtime/telemetry.py): structured JSONL metrics stream
@@ -78,6 +88,15 @@ def build_parser() -> argparse.ArgumentParser:
         "fused runs emit them from the scan itself, no extra host syncs), "
         "plus launch accounting, transfer counters, and memory gauges; "
         "summarize with benches/summarize_metrics.py",
+    )
+    ap.add_argument(
+        "--stream-rounds", action="store_true",
+        help="with --metrics-out and a fused launch (--rounds-per-launch > "
+        "1): emit one 'round_stream' JSONL event per round from INSIDE the "
+        "running chunk via jax.debug.callback — live progress during long "
+        "chunks instead of only at touchdowns. Off by default (the callback "
+        "rides the traced program; the zero-overhead fast path stays "
+        "untouched without the flag)",
     )
     ap.add_argument(
         "--profile-dir", default=None, metavar="DIR",
@@ -273,6 +292,8 @@ def main(argv=None) -> int:
         max_rounds=args.rounds,
         label_budget=args.budget,
         rounds_per_launch=args.rounds_per_launch,
+        pipeline_depth=args.pipeline_depth,
+        stream_round_events=args.stream_rounds,
         seed=args.seed,
         results_path=None,  # _emit handles --out for both loop kinds
         checkpoint_dir=args.checkpoint_dir,
@@ -394,6 +415,9 @@ def _run_neural(args, dbg, metrics=None):
         coreset_space=args.coreset_space,
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every=args.checkpoint_every,
+        rounds_per_launch=args.rounds_per_launch,
+        pipeline_depth=args.pipeline_depth,
+        stream_round_events=args.stream_rounds,
         mesh=MeshConfig(data=args.mesh_data, model=args.mesh_model),
     )
     # Dataset identity feeds the checkpoint fingerprint, so a resume against a

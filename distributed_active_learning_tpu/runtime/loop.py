@@ -18,7 +18,6 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-import time
 from typing import Optional, Tuple
 
 import jax
@@ -198,6 +197,7 @@ def make_chunk_fn(
     with_metrics: bool = False,
     n_classes: int = 2,
     donate: bool = True,
+    stream_cb=None,
 ):
     """Fuse ``chunk_size`` AL rounds into ONE jitted ``lax.scan`` program.
 
@@ -226,14 +226,25 @@ def make_chunk_fn(
     path.
 
     Returns ``chunk_fn(codes, state, aux, fit_key, test_x, test_y,
-    end_round) -> (new_state, (rounds, n_labeled, accuracy, picked,
+    end_round) -> (new_state, extras, (rounds, n_labeled, accuracy, picked,
     active[, metrics]))`` where each y is stacked ``[chunk_size, ...]``;
     ``n_labeled`` is the pre-reveal count (what the evaluated forest was
     trained on, the reference's print ordering) and ``end_round`` rides as a
-    traced scalar so ``max_rounds`` changes never recompile. With
-    ``with_metrics`` a stacked :class:`~runtime.telemetry.RoundMetrics`
-    pytree rides as a sixth y — per-round observability for fused runs at
-    the cost of a few extra KB in the touchdown fetch, zero extra syncs.
+    traced scalar so ``max_rounds`` changes never recompile. ``extras`` is a
+    :class:`~runtime.pipeline.ChunkExtras` — the exact post-chunk labeled
+    count and the active-round count as two int32 scalars, the ONLY values
+    the pipelined driver blocks on per chunk (the bulk ys transfer stays
+    asynchronous). With ``with_metrics`` a stacked
+    :class:`~runtime.telemetry.RoundMetrics` pytree rides as a sixth y —
+    per-round observability for fused runs at the cost of a few extra KB in
+    the touchdown fetch, zero extra syncs.
+
+    ``stream_cb`` (optional host callable ``(round, n_labeled, accuracy,
+    active) -> None``) is invoked from INSIDE the scan via
+    ``jax.debug.callback`` once per round — live round events during a long
+    chunk instead of only at its touchdown. Callback events are unordered
+    (each carries its round number) and the hook is absent from the traced
+    program when ``stream_cb is None``, so the default fast path is untouched.
 
     ``donate`` donates the carried ``state``'s buffers to the launch
     (``donate_argnums``): the scan carry aliases the input pool arrays
@@ -283,12 +294,21 @@ def make_chunk_fn(
                 new_state, picked, _ = round_fn(forest, carry, aux)
             acc = _accuracy(forest, test_x, test_y)
             out = state_lib.select_state(active, new_state, carry)
+            if stream_cb is not None:
+                jax.debug.callback(stream_cb, carry.round + 1, n_labeled, acc, active)
             ys = (carry.round + 1, n_labeled, acc, picked, active)
             if with_metrics:
                 ys = ys + (rm,)
             return out, ys
 
-        return jax.lax.scan(body, state, None, length=chunk_size)
+        out_state, ys = jax.lax.scan(body, state, None, length=chunk_size)
+        from distributed_active_learning_tpu.runtime.pipeline import ChunkExtras
+
+        extras = ChunkExtras(
+            n_labeled_after=state_lib.labeled_count(out_state),
+            n_active=jnp.sum(ys[4].astype(jnp.int32)),
+        )
+        return out_state, extras, ys
 
     return chunk_fn
 
@@ -472,16 +492,40 @@ def run_experiment(
         and not getattr(dbg, "phase_detail", False)
     )
     if use_chunked:
-        from distributed_active_learning_tpu.runtime import telemetry
+        from distributed_active_learning_tpu.runtime import (
+            pipeline as pipeline_lib,
+            telemetry,
+        )
 
         K, window = cfg.rounds_per_launch, cfg.strategy.window_size
         label_cap = n_pool if cfg.label_budget is None else min(cfg.label_budget, n_pool)
+        depth = max(int(getattr(cfg, "pipeline_depth", 1) or 1), 1)
+        ckpt_enabled = bool(cfg.checkpoint_dir and cfg.checkpoint_every)
+        # Mid-chunk round streaming (ROADMAP PR-3 follow-up): a host callback
+        # riding jax.debug.callback inside the scan, behind the explicit flag
+        # so the zero-overhead fast path's traced program is unchanged.
+        stream_cb = None
+        if metrics is not None and cfg.stream_round_events:
+            def stream_cb(round_, n_labeled_cb, acc_cb, active_cb):
+                if bool(active_cb):
+                    metrics.event(
+                        "round_stream",
+                        round=int(round_),
+                        n_labeled=int(n_labeled_cb),
+                        accuracy=float(acc_cb),
+                    )
         chunk_fn = make_chunk_fn(
             strategy, window, K, device_fit, label_cap,
             mesh=mesh,
             wrap_pallas=(mesh is not None and cfg.forest.kernel == "pallas"),
             with_metrics=want_metrics,
             n_classes=n_classes,
+            # Checkpointed runs keep the carry un-donated: the pipelined
+            # driver dispatches chunk N+1 (which would consume and delete
+            # chunk N's output buffers) BEFORE chunk N's touchdown saves
+            # that very state to disk.
+            donate=not ckpt_enabled,
+            stream_cb=stream_cb,
         )
         # The chunk donates the carried state's buffers; at round 0
         # aux.seed_mask aliases state.labeled_mask, and a donated alias would
@@ -494,53 +538,46 @@ def run_experiment(
             if cfg.max_rounds is not None
             else int(np.iinfo(np.int32).max)
         )
-        # One sync at loop entry; afterwards the labeled count is tracked from
-        # chunk outputs (upper-bounded by +window past the last pre-reveal
-        # count — exact enough for the stop test, see break conditions below).
+        # One sync at loop entry; afterwards the driver blocks only on each
+        # chunk's two stop scalars (ChunkExtras). All stop/veto/checkpoint
+        # arithmetic lives in the shared ChunkDriveControl (the neural loop
+        # runs the identical logic).
         n_known = int(state_lib.labeled_count(state))
-        ckpt_mark = start_round
-        while True:
-            if n_known >= label_cap:
-                break
-            if cfg.max_rounds is not None and round_idx - start_round >= cfg.max_rounds:
-                break
-            # Projected upper bound on any ACTIVE in-chunk fit's labeled rows:
-            # raised here (pre-launch) instead of mid-round — an in-scan fit
-            # cannot raise, and letting gather_fit_window silently truncate
-            # would corrupt the curve. Only rounds that can still be active
-            # count (inactive tail fits are computed but discarded); slightly
-            # more conservative than the per-round check (projects a whole
-            # chunk ahead).
-            rounds_left = (
-                K
-                if cfg.max_rounds is None
-                else min(K, cfg.max_rounds - (round_idx - start_round))
-            )
-            # Pre-reveal counts advance on the n_known + j*window lattice, and
-            # an active round needs its count < label_cap — so the largest
-            # reachable ACTIVE fit size is the last lattice point under the
-            # cap, not label_cap - 1 (which may be unreachable and would
-            # falsely reject configs the per-round driver completes).
+        ctl = pipeline_lib.ChunkDriveControl(
+            K, window, label_cap, cfg.max_rounds, n_known, start_round
+        )
+        if not ctl.already_done:
+            # Projected upper bound on any ACTIVE fit's labeled rows over the
+            # WHOLE run: raised here (loop entry) instead of mid-round — an
+            # in-scan fit cannot raise, and letting gather_fit_window silently
+            # truncate would corrupt the curve. Pre-reveal counts advance on
+            # the n_known + j*window lattice and an active round needs its
+            # count < label_cap, so the largest reachable ACTIVE fit size is
+            # the last lattice point under the cap (not label_cap - 1, which
+            # may be unreachable and would falsely reject configs the
+            # per-round driver completes), further capped by max_rounds.
             j_cap = -(-(label_cap - n_known) // window) - 1  # ceil-div - 1
-            projected = n_known + min(rounds_left - 1, j_cap) * window
+            if cfg.max_rounds is not None:
+                j_cap = min(cfg.max_rounds - 1, j_cap)
+            projected = n_known + max(j_cap, 0) * window
             if projected > fit_budget:
                 raise ValueError(
                     f"up to {projected} labeled rows would exceed the device "
-                    f"fit window ({fit_budget}) within one {K}-round launch; "
-                    "raise ForestConfig.fit_budget or lower rounds_per_launch"
+                    f"fit window ({fit_budget}); raise ForestConfig.fit_budget "
+                    "or lower label_budget/max_rounds"
                 )
-            t0 = time.perf_counter()
-            out = chunk_fn(codes, state, aux, fit_key, test_x, test_y, end_round)
-            state, ys = out
-            rounds_y, labeled_y, acc_y, _picked_y, active_y = ys[:5]
-            # The chunk's ONE host touchdown: fetch the stacked ys, bulk-append
-            # records, log, maybe checkpoint.
-            active_np = np.asarray(active_y)
-            wall = time.perf_counter() - t0
-            launches.record(wall)
-            n_active = int(active_np.sum())
+
+        def dispatch(st, _idx):
+            return chunk_fn(codes, st, aux, fit_key, test_x, test_y, end_round)
+
+        def touchdown(_idx, _n_labeled_after, n_active, ys, out_state, wall):
+            # The chunk's host touchdown: materialize the (already async-
+            # copied) stacked ys, bulk-append records, log, maybe checkpoint.
+            # Runs overlapped with the next chunk's execution when depth > 1.
             if n_active == 0:
-                break
+                return  # wholly-inactive (speculative tail) chunk: no-op
+            rounds_y, labeled_y, acc_y, _picked_y, active_y = ys[:5]
+            active_np = np.asarray(active_y)
             rounds_np = np.asarray(rounds_y)[active_np]
             labeled_np = np.asarray(labeled_y)[active_np]
             acc_np = np.asarray(acc_y)[active_np]
@@ -554,13 +591,7 @@ def run_experiment(
                 total_time=wall / n_active,
                 metrics=round_dicts,
             )
-            round_idx = int(rounds_np[-1])
-            # Post-reveal count of the last active round: its pre-reveal count
-            # plus at most one window. If that bound reaches label_cap the next
-            # round is necessarily inactive (a short reveal only happens on
-            # pool exhaustion, which also stops), so breaking on the bound
-            # never skips a round the per-round driver would have run.
-            n_known = min(int(labeled_np[-1]) + window, n_pool)
+            ctl.note_round(int(rounds_np[-1]))
             if metrics is not None:
                 # Touchdown accounting: bytes actually fetched to the host
                 # this launch (stacked ys + metrics), then one round event per
@@ -593,25 +624,32 @@ def run_experiment(
                             f"Iteration {int(r)} -- labeled={int(nl)} "
                             f"accu={float(a) * 100:.2f}"
                         )
-            if (
-                cfg.checkpoint_dir
-                and cfg.checkpoint_every
-                and round_idx // cfg.checkpoint_every > ckpt_mark // cfg.checkpoint_every
-            ):
+            if ckpt_enabled and ctl.checkpoint_due(cfg.checkpoint_every):
                 # Chunk-boundary checkpointing: saved at the first touchdown
                 # after each checkpoint_every multiple (steps need not align
                 # with the multiple itself — runtime/checkpoint.py notes).
+                # out_state is this chunk's post-chunk carry, valid to read
+                # here because checkpointed runs build the chunk un-donated.
                 from distributed_active_learning_tpu.runtime import (
                     checkpoint as ckpt_lib,
                 )
 
                 ckpt_lib.save(
-                    cfg.checkpoint_dir, state, result,
+                    cfg.checkpoint_dir, out_state, result,
                     fingerprint=ckpt_fp, kernel=ckpt_kernel,
                 )
-                ckpt_mark = round_idx
-            if not active_np.all():
-                break  # an in-chunk round hit the budget/pool stop
+                ctl.checkpoint_done()
+
+        if not ctl.already_done:
+            state, _stats = pipeline_lib.run_pipelined(
+                state,
+                dispatch=dispatch,
+                touchdown=touchdown,
+                continue_after=ctl.continue_after,
+                depth=depth,
+                on_launch=launches.record,
+                may_dispatch=ctl.may_dispatch,
+            )
 
         if cfg.results_path:
             result.save(cfg.results_path, fmt="reference")

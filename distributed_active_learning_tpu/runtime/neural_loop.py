@@ -14,6 +14,7 @@ from typing import Callable, Dict, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from distributed_active_learning_tpu.config import MeshConfig
 from distributed_active_learning_tpu.models.neural import NeuralLearner, TrainState
@@ -81,6 +82,22 @@ class NeuralExperimentConfig:
     # (round-2 gap: the neural path was a parallel universe with neither).
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 0
+    # Scan-fuse K AL rounds (fit + acquire + eval) into ONE jitted lax.scan
+    # launch, exactly like the forest loop's knob of the same name: the carry
+    # is (net TrainState, PoolState, loop key), stopping stays exact via
+    # masked in-scan no-ops, and results are bit-identical to the per-round
+    # loop (tests/test_pipeline.py). Engages for the in-scan-fusable
+    # strategies (the MC-score family + random + density); batchbald/coreset/
+    # badge unroll their greedy selection k times per round and fall back to
+    # the per-round loop rather than paying a k*K-times-unrolled compile.
+    rounds_per_launch: int = 1
+    # Chunk launches in flight at once (runtime/pipeline.py; 1 = strict
+    # serial launch -> block -> touchdown). Performance-only.
+    pipeline_depth: int = 2
+    # Emit live "round_stream" JSONL events from INSIDE running chunks via
+    # jax.debug.callback (needs a MetricsWriter and rounds_per_launch > 1) —
+    # same flag and semantics as ExperimentConfig.stream_round_events.
+    stream_round_events: bool = False
     # Pool rows ride the data axis (DP over the mesh); the network itself is
     # replicated — its parameters are tiny next to a CIFAR-50k pool, so data
     # parallelism is the whole win and model sharding stays out of scope.
@@ -155,6 +172,139 @@ def _place_on_mesh(cfg: MeshConfig, state, pool_x, net_state):
     pool_x = global_put(pool_x, mesh, P("data", *([None] * (pool_x.ndim - 1))))
     net_state = jax.tree.map(lambda l: global_put(l, mesh, P()), net_state)
     return mesh, state, pool_x, net_state
+
+
+#: Deep strategies whose acquire program fuses into the scanned chunk: the
+#: MC-score family plus random and density are a fixed pipeline of
+#: predict/score/top-k ops. batchbald/coreset/badge unroll a greedy selection
+#: ``window_size`` times per round — inside a K-round scan that is a k*K-fold
+#: unroll, so they keep the per-round loop instead.
+FUSABLE_STRATEGIES = frozenset(_SCORES) | {"random", "density"}
+
+
+def make_neural_chunk_fn(
+    learner: NeuralLearner,
+    strat: str,
+    window_size: int,
+    chunk_size: int,
+    label_cap: int,
+    retrain_from_scratch: bool = True,
+    beta: float = 1.0,
+    with_metrics: bool = False,
+    n_classes: int = 2,
+    stream_cb=None,
+):
+    """Fuse ``chunk_size`` neural AL rounds into ONE jitted ``lax.scan``.
+
+    The neural counterpart of ``runtime.loop.make_chunk_fn``: per scan step,
+    (re)train the network on the masked labeled subset (``fit_on_mask`` is
+    already a fully-jitted train scan), draw the strategy's MC predictive
+    samples, score + select + reveal, and evaluate test accuracy — all inside
+    one launch. The carry is ``(net TrainState, PoolState, loop key)``;
+    stopping stays exact via the same masked no-op discipline as the forest
+    chunk (``active = labeled < cap  &  round < end_round``; an inactive step
+    passes the whole carry through a ``lax.cond`` untouched, key included, so
+    a chunk overrunning the stop point is bit-free).
+
+    The per-round PRNG protocol is IDENTICAL to the per-round loop —
+    ``key, k_fit, k_mc, k_rand = jax.random.split(key, 4)`` at each step — so
+    fused and per-round curves match bit-for-bit (tests/test_pipeline.py).
+
+    Returns ``chunk_fn(net_state, state, key, pool_x, init_net, test_x,
+    test_y, end_round) -> ((net, state, key), ChunkExtras, (rounds,
+    n_labeled, accuracy, picked, active[, metrics]))`` with each y stacked
+    ``[chunk_size, ...]``; ``extras`` carries the post-chunk labeled count and
+    active-round count — the only scalars the pipelined driver blocks on.
+    With ``with_metrics`` a stacked :class:`~runtime.telemetry.RoundMetrics`
+    rides as a sixth y (``telemetry.selection_metrics`` over the acquisition
+    scores, pool entropy from the MC predictive samples — closing the
+    ROADMAP follow-up that fused runs had host-side round events only).
+
+    Only strategies in :data:`FUSABLE_STRATEGIES` are supported; the caller
+    (``run_neural_experiment``) falls back to the per-round loop otherwise.
+    The carry is NOT donated: the pipelined driver's touchdown may checkpoint
+    the post-chunk ``(net, state, key)`` after the next chunk already
+    launched, which donation would have deleted (runtime/pipeline.py notes).
+    """
+    if strat not in FUSABLE_STRATEGIES:
+        raise ValueError(
+            f"strategy {strat!r} cannot fuse in-scan; fusable: "
+            f"{sorted(FUSABLE_STRATEGIES)}"
+        )
+    from distributed_active_learning_tpu.runtime.pipeline import ChunkExtras
+
+    @jax.jit
+    def chunk_fn(net_state, state, key, pool_x, init_net, test_x, test_y, end_round):
+        def body(carry, _):
+            net_c, st, k = carry
+            n_labeled = state_lib.labeled_count(st)
+            active = (n_labeled < label_cap) & (st.round < end_round)
+            k_next, k_fit, k_mc, k_rand = jax.random.split(k, 4)
+
+            net_in = init_net if retrain_from_scratch else net_c
+            fit_mask = st.labeled_mask
+            if st.n_valid != st.n_pool:
+                fit_mask = fit_mask & st.valid_mask
+            net = learner.fit_on_mask(net_in, pool_x, st.oracle_y, fit_mask, k_fit)
+
+            unlabeled = ~st.labeled_mask
+            probs = None
+            if strat != "random" or with_metrics:
+                probs = learner.predict_proba_samples(net, pool_x, k_mc)
+            if strat == "random":
+                scores = jax.random.uniform(k_rand, (st.n_pool,))
+            elif strat == "density":
+                from distributed_active_learning_tpu.ops.similarity import (
+                    similarity_mass,
+                )
+
+                ent = deep.predictive_entropy(probs)
+                emb = learner.embed(net, pool_x)
+                mass = jnp.maximum(similarity_mass(emb, unlabeled), 0.0)
+                scores = ent * jnp.power(mass, beta)
+            else:
+                scores = _SCORES[strat](probs)
+            vals, picked = select_top_k(scores, unlabeled, window_size)
+            new_st = state_lib.reveal(st, picked)
+
+            acc = jnp.mean(
+                (
+                    jnp.argmax(learner.predict_proba(net, test_x), -1) == test_y
+                ).astype(jnp.float32)
+            )
+            out = jax.lax.cond(
+                active,
+                lambda: (net, new_st, k_next),
+                lambda: carry,
+            )
+            if stream_cb is not None:
+                # Live in-scan round events (same contract as the forest
+                # chunk: unordered, each carries its round number; absent
+                # from the traced program when the flag is off).
+                jax.debug.callback(stream_cb, st.round + 1, n_labeled, acc, active)
+            ys = (st.round + 1, n_labeled, acc, picked, active)
+            if with_metrics:
+                from distributed_active_learning_tpu.runtime import telemetry
+
+                rm = telemetry.selection_metrics(
+                    st, picked, vals, scores,
+                    higher_is_better=True,
+                    n_classes=n_classes,
+                    pool_entropy=deep.predictive_entropy(probs),
+                )
+                ys = ys + (rm,)
+            return out, ys
+
+        (net_out, st_out, key_out), ys = jax.lax.scan(
+            body, (net_state, state, key), None, length=chunk_size
+        )
+        extras = ChunkExtras(
+            n_labeled_after=state_lib.labeled_count(st_out),
+            n_active=jnp.sum(ys[4].astype(jnp.int32)),
+        )
+        return (net_out, st_out, key_out), extras, ys
+
+    return chunk_fn
 
 
 def run_neural_experiment(
@@ -244,6 +394,125 @@ def run_neural_experiment(
         )
 
     n_pool = state.n_valid  # real rows; mesh padding is never selectable
+
+    # Scan-fused + pipelined driver (the forest loop's PR-2/PR-4 discipline
+    # applied to the neural path): K rounds per launch, touchdowns overlapped
+    # with the next chunk's execution, stop decisions off two scalars.
+    # Host-bound acquire programs (batchbald/coreset/badge) and explicit
+    # per-phase timing requests fall back to the per-round loop below.
+    use_chunked = (
+        cfg.rounds_per_launch > 1
+        and strat in FUSABLE_STRATEGIES
+        and not getattr(dbg, "phase_detail", False)
+    )
+    if use_chunked:
+        from distributed_active_learning_tpu.runtime import (
+            pipeline as pipeline_lib,
+            telemetry,
+        )
+
+        K, window = cfg.rounds_per_launch, cfg.window_size
+        label_cap = n_pool if cfg.label_budget is None else min(cfg.label_budget, n_pool)
+        depth = max(int(getattr(cfg, "pipeline_depth", 1) or 1), 1)
+        want_metrics = metrics is not None
+        stream_cb = None
+        if metrics is not None and cfg.stream_round_events:
+            def stream_cb(round_, n_labeled_cb, acc_cb, active_cb):
+                if bool(active_cb):
+                    metrics.event(
+                        "round_stream",
+                        round=int(round_),
+                        n_labeled=int(n_labeled_cb),
+                        accuracy=float(acc_cb),
+                    )
+        chunk_fn = make_neural_chunk_fn(
+            learner, strat, window, K, label_cap,
+            retrain_from_scratch=cfg.retrain_from_scratch,
+            beta=cfg.beta,
+            with_metrics=want_metrics,
+            n_classes=max(n_classes, 2),
+            stream_cb=stream_cb,
+        )
+        launches = telemetry.LaunchTracker(metrics, "neural_chunk_scan", fn=chunk_fn)
+        end_round = (
+            start_round + cfg.max_rounds
+            if cfg.max_rounds is not None
+            else int(np.iinfo(np.int32).max)
+        )
+        # Stop/veto/checkpoint arithmetic shared verbatim with the forest
+        # driver (runtime/pipeline.py ChunkDriveControl): only the chunk
+        # program and the touchdown body differ between the two loops.
+        n_known = int(state_lib.labeled_count(state))
+        ctl = pipeline_lib.ChunkDriveControl(
+            K, window, label_cap, cfg.max_rounds, n_known, start_round
+        )
+        ckpt_enabled = bool(cfg.checkpoint_dir and cfg.checkpoint_every)
+
+        def dispatch(carry, _idx):
+            net_c, st, k = carry
+            return chunk_fn(
+                net_c, st, k, pool_x, init_net_state, test_x, test_y, end_round
+            )
+
+        def touchdown(_idx, _n_labeled_after, n_active, ys, out_carry, wall):
+            if n_active == 0:
+                return  # wholly-inactive (speculative tail) chunk
+            rounds_y, labeled_y, acc_y, _picked_y, active_y = ys[:5]
+            active_np = np.asarray(active_y)
+            rounds_np = np.asarray(rounds_y)[active_np]
+            labeled_np = np.asarray(labeled_y)[active_np]
+            acc_np = np.asarray(acc_y)[active_np]
+            round_dicts = (
+                telemetry.stacked_metrics_to_dicts(ys[5], active_np)
+                if want_metrics
+                else None
+            )
+            result.extend_from_arrays(
+                rounds_np, labeled_np, n_pool - labeled_np, acc_np,
+                total_time=wall / n_active,
+                metrics=round_dicts,
+            )
+            ctl.note_round(int(rounds_np[-1]))
+            if metrics is not None:
+                for i in range(n_active):
+                    metrics.round(
+                        round=int(rounds_np[i]),
+                        n_labeled=int(labeled_np[i]),
+                        accuracy=float(acc_np[i]),
+                        **(round_dicts[i] if round_dicts else {}),
+                    )
+            if ckpt_enabled and ctl.checkpoint_due(cfg.checkpoint_every):
+                # Chunk-boundary checkpointing (first touchdown at/after each
+                # checkpoint_every multiple). The carry is un-donated, so the
+                # post-chunk (net, state, key) is valid to persist here even
+                # though the next chunk already launched from it.
+                from distributed_active_learning_tpu.runtime import (
+                    checkpoint as ckpt_lib,
+                )
+
+                net_o, st_o, key_o = out_carry
+                ckpt_lib.save_neural(
+                    cfg.checkpoint_dir, st_o, result, net_o, key_o,
+                    fingerprint=ckpt_fp,
+                )
+                ctl.checkpoint_done()
+
+        if not ctl.already_done:
+            _carry, _stats = pipeline_lib.run_pipelined(
+                (net_state, state, key),
+                dispatch=dispatch,
+                touchdown=touchdown,
+                continue_after=ctl.continue_after,
+                depth=depth,
+                on_launch=launches.record,
+                may_dispatch=ctl.may_dispatch,
+            )
+        if metrics is not None:
+            mem = telemetry.device_memory_gauges()
+            if mem:
+                metrics.gauges(mem, allgather=True)
+        return result
+
     round_idx = start_round
     while True:
         n_labeled = int(state_lib.labeled_count(state))

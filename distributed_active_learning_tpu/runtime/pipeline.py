@@ -1,0 +1,313 @@
+"""Pipelined chunk dispatch: overlap device execution with host touchdowns.
+
+PR 2 fused K AL rounds into one ``lax.scan`` launch, but the driver around it
+stayed strictly serial: launch -> block on the stacked ys -> append records /
+log / checkpoint -> launch the next chunk. Every chunk boundary therefore
+stalls the device for the whole host touchdown. This module is the
+dispatch-ahead-of-data discipline (Pathways, Barham et al. 2022) applied to
+that boundary, shared by BOTH experiment loops (forest ``runtime.loop`` and
+neural ``runtime.neural_loop``):
+
+- **Chunks dispatch ahead of their results.** ``dispatch`` returns immediately
+  (JAX launches are async); up to ``depth`` chunks are in flight at once. The
+  carried state is device-resident and threads launch-to-launch without the
+  host ever materializing it.
+
+- **The stop decision blocks only on two scalars.** Each chunk returns its
+  post-chunk labeled count and active-round count as tiny scalar outputs
+  (:class:`ChunkExtras`); the driver's continue/stop logic needs nothing else,
+  so the bulk ys transfer never serializes the loop.
+
+- **The bulk ys fetch is asynchronous.** Right after a chunk is dispatched its
+  ys start a non-blocking device-to-host copy (``copy_to_host_async``); by the
+  time the touchdown materializes them the transfer has typically already
+  completed under the next chunk's execution.
+
+- **Touchdowns overlay the next chunk's execution.** After chunk N's scalars
+  arrive, chunk N+2 is dispatched (informed by N's outcome) and only THEN does
+  chunk N's touchdown (record append, metrics, logging, checkpoint) run — the
+  device crunches chunk N+1/N+2 while the host does its bookkeeping.
+
+- **One speculative chunk may run past the stop point.** With ``depth=2``
+  chunk N+1 launches before chunk N's outcome is known; if N stopped, N+1 is
+  wholly inactive — the masked no-op rounds freeze the carried state bit-for-
+  bit and append nothing, so results are IDENTICAL to the serial driver
+  (pinned in tests/test_pipeline.py). ``depth=1`` reproduces today's strict
+  launch -> block -> touchdown order exactly (the fallback for host fit and
+  ``--phase-detail``).
+
+Donation note: with buffer donation the output carry of chunk N is consumed
+(and its buffers deleted) by chunk N+1's launch BEFORE chunk N's touchdown
+runs, so a touchdown must not read the carry it is handed unless the caller
+disabled donation — the drivers disable it exactly when checkpointing needs
+the post-chunk state on the host (runtime/loop.py, runtime/neural_loop.py).
+
+Overlap accounting rides the existing telemetry: each chunk's ``launch`` JSONL
+event gains ``touchdown_seconds``, ``overlap_seconds`` (the part of the
+touchdown that ran while another chunk was in flight) and
+``touchdown_hidden_fraction``; :class:`PipelineStats` aggregates the same
+numbers for ``bench.py --mode round``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+from typing import Any, Callable, NamedTuple, Optional
+
+import jax
+
+
+class ChunkExtras(NamedTuple):
+    """The two scalar chunk outputs the host stop decision blocks on.
+
+    Everything else a chunk produces (the stacked ys, the carried state) is
+    fetched asynchronously or never fetched at all; these two int32 scalars
+    are the whole launch-to-launch control dependency.
+    """
+
+    n_labeled_after: Any  # exact post-chunk labeled count (real rows only)
+    n_active: Any         # how many of the chunk's rounds were active
+
+
+@dataclasses.dataclass
+class PipelineStats:
+    """Aggregate dispatch-vs-touchdown overlap accounting for one drive."""
+
+    chunks: int = 0
+    launch_seconds: float = 0.0     # dispatch -> stop-scalars-ready, summed
+    touchdown_seconds: float = 0.0  # host bookkeeping wall, summed
+    overlap_seconds: float = 0.0    # touchdown wall spent with a chunk in flight
+
+    @property
+    def touchdown_hidden_fraction(self) -> float:
+        """Fraction of total touchdown wall the device never saw (it was
+        executing another chunk at the time). 0.0 for the serial order
+        (depth=1), approaching 1.0 when every touchdown hides behind the next
+        chunk's execution."""
+        if self.touchdown_seconds <= 0.0:
+            return 0.0
+        return self.overlap_seconds / self.touchdown_seconds
+
+
+@dataclasses.dataclass
+class _InFlight:
+    index: int
+    extras: ChunkExtras
+    ys: Any
+    out_state: Any
+    t_dispatch: float
+
+
+class ChunkDriveControl:
+    """Shared stop/veto/checkpoint arithmetic for chunked experiment drivers.
+
+    The forest and neural loops drive different chunk programs but IDENTICAL
+    control logic: when a speculative dispatch is provably inactive
+    (:meth:`may_dispatch` — max_rounds bound, or the labeled-count lattice
+    reaching the label cap), when to stop after a chunk's scalars arrive
+    (:meth:`continue_after` — short chunk / cap reached / round quota spent),
+    and the first-touchdown-at-or-after-each-multiple checkpoint cadence.
+    One implementation here keeps the two drivers from drifting.
+
+    The lattice veto is SAFE, never lossy: pre-reveal counts advance by
+    exactly ``window`` per active round except at pool-exhaustion short
+    reveals — and after a short reveal the count equals the pool size, so
+    every later round is inactive anyway. Hence ``lattice >= cap`` implies
+    the real round is inactive too.
+    """
+
+    def __init__(
+        self,
+        chunk_size: int,
+        window: int,
+        label_cap: int,
+        max_rounds: Optional[int],
+        n_known: int,
+        start_round: int = 0,
+    ):
+        self.chunk_size = chunk_size
+        self.window = window
+        self.label_cap = label_cap
+        self.max_rounds = max_rounds
+        self.n_known = n_known
+        self.rounds_done = 0
+        self.round_idx = start_round
+        self._ckpt_mark = start_round
+
+    @property
+    def already_done(self) -> bool:
+        """True when not even the first chunk should launch."""
+        return self.n_known >= self.label_cap or (
+            self.max_rounds is not None and self.max_rounds <= 0
+        )
+
+    def may_dispatch(self, idx: int) -> bool:
+        if self.max_rounds is not None and idx * self.chunk_size >= self.max_rounds:
+            return False
+        return self.n_known + idx * self.chunk_size * self.window < self.label_cap
+
+    def continue_after(self, n_labeled_after: int, n_active: int) -> bool:
+        self.rounds_done += n_active
+        if n_active < self.chunk_size:
+            return False  # an in-chunk round hit the budget/pool/end stop
+        if n_labeled_after >= self.label_cap:
+            return False
+        if self.max_rounds is not None and self.rounds_done >= self.max_rounds:
+            return False
+        return True
+
+    # -- chunk-boundary checkpoint cadence (runtime/checkpoint.py notes):
+    # saved at the first touchdown at/after each checkpoint_every multiple.
+
+    def note_round(self, round_idx: int) -> None:
+        """Record the last active round a touchdown appended."""
+        self.round_idx = round_idx
+
+    def checkpoint_due(self, every: int) -> bool:
+        return self.round_idx // every > self._ckpt_mark // every
+
+    def checkpoint_done(self) -> None:
+        self._ckpt_mark = self.round_idx
+
+
+def start_host_copy(tree: Any) -> None:
+    """Begin a non-blocking device->host copy of every array in ``tree``.
+
+    The copy completes under the next chunk's execution, so the touchdown's
+    ``np.asarray`` calls find the bytes already on host. Arrays that don't
+    support the call (non-jax leaves, committed multi-device layouts on some
+    backends) just skip — the later synchronous fetch stays correct, only
+    less overlapped.
+    """
+    for leaf in jax.tree_util.tree_leaves(tree):
+        try:
+            leaf.copy_to_host_async()
+        except (AttributeError, RuntimeError):
+            pass
+
+
+def run_pipelined(
+    state: Any,
+    *,
+    dispatch: Callable[[Any, int], tuple],
+    touchdown: Callable[[int, int, int, Any, Any, float], None],
+    continue_after: Callable[[int, int], bool],
+    depth: int = 2,
+    on_launch: Optional[Callable[..., None]] = None,
+    may_dispatch: Optional[Callable[[int], bool]] = None,
+) -> tuple:
+    """Drive chunk launches with up to ``depth`` in flight; returns
+    ``(final_state, PipelineStats)``.
+
+    - ``dispatch(state, chunk_index) -> (new_state, ChunkExtras, ys)`` must be
+      non-blocking (a jitted launch). The returned state is device-resident
+      and threads into the next dispatch; the pipeline never reads it.
+    - ``continue_after(n_labeled_after, n_active) -> bool`` is the host stop
+      decision, called once per chunk IN ORDER with the two scalars as plain
+      ints. Returning False stops further dispatch; chunks already in flight
+      still get their touchdown (they are wholly-inactive no-ops).
+    - ``touchdown(chunk_index, n_labeled_after, n_active, ys, out_state,
+      launch_seconds)`` does the host bookkeeping (record append, metrics,
+      logging, checkpoint). Runs strictly in chunk order, overlapped with
+      in-flight execution when ``depth > 1``. ``out_state`` is that chunk's
+      output carry — only valid to read when the chunk program does NOT
+      donate its carry (see module docstring).
+    - ``on_launch(seconds=, touchdown_seconds=, overlap_seconds=,
+      touchdown_hidden_fraction=)`` (optional) receives per-chunk timing once
+      the chunk's touchdown finished — the telemetry hook
+      (:meth:`runtime.telemetry.LaunchTracker.record`).
+    - ``may_dispatch(chunk_index) -> bool`` (optional) vetoes a dispatch the
+      caller can PROVE would be wholly inactive (a-priori bounds: max_rounds,
+      or the labeled-count lattice reaching the label cap) — the driver then
+      skips the speculative launch instead of burning a masked no-op chunk.
+      Must be monotone (once False, False forever). Stops the host can NOT
+      predict (pool exhaustion short-reveals) still rely on speculation +
+      masked no-ops, which stay bit-exact.
+
+    ``depth=1`` degenerates to the serial launch -> block -> touchdown order:
+    no speculation, no overlap, bit-identical behavior AND ordering to the
+    pre-pipeline driver.
+    """
+    if depth < 1:
+        raise ValueError(f"pipeline depth must be >= 1, got {depth}")
+    stats = PipelineStats()
+    inflight: deque = deque()
+    stop = False
+    next_index = 0
+    last_ready = None  # when the previous chunk's scalars resolved
+
+    def _can_dispatch():
+        return (
+            not stop
+            and (may_dispatch is None or may_dispatch(next_index))
+        )
+
+    def _dispatch_one():
+        nonlocal state, next_index
+        t0 = time.perf_counter()
+        state, extras, ys = dispatch(state, next_index)
+        # Kick off the async D2H copy of everything the touchdown will read.
+        start_host_copy((extras, ys))
+        inflight.append(_InFlight(next_index, extras, ys, state, t0))
+        next_index += 1
+
+    while True:
+        # Fill the launch window. The chunk beyond the oldest un-consumed one
+        # is speculative (its predecessor's outcome is unknown) — masked
+        # no-op rounds make an overrun free and bit-exact.
+        while _can_dispatch() and len(inflight) < depth:
+            _dispatch_one()
+        if not inflight:
+            break
+        head = inflight.popleft()
+        # The ONLY blocking fetch: two scalars. The chunk program must finish
+        # for them to resolve.
+        n_labeled_after = int(head.extras.n_labeled_after)
+        n_active = int(head.extras.n_active)
+        ready = time.perf_counter()
+        # Wall attributed to THIS chunk: from the later of its dispatch and
+        # the previous chunk's completion, to its own completion. At depth 1
+        # that is plain dispatch->ready; at depth >= 2 a chunk dispatched
+        # while its predecessor still executed must not re-count the
+        # predecessor's device time (naive dispatch->ready would ~double
+        # every per-launch/per-round figure and make launch seconds sum past
+        # real wall clock).
+        since = (
+            head.t_dispatch
+            if last_ready is None
+            else max(head.t_dispatch, last_ready)
+        )
+        launch_wall = ready - since
+        last_ready = ready
+        if not stop and not continue_after(n_labeled_after, n_active):
+            stop = True
+        # Refill BEFORE the touchdown so the host bookkeeping below overlays
+        # the refilled chunk's execution: the popped chunk has completed, so
+        # the launch window has a free slot and chunk N+2 can dispatch now —
+        # the device never waits out a long touchdown. depth=1 skips this
+        # (the serial contract is touchdown-before-next-dispatch).
+        while depth > 1 and _can_dispatch() and len(inflight) < depth:
+            _dispatch_one()
+        t_td = time.perf_counter()
+        touchdown(
+            head.index, n_labeled_after, n_active, head.ys, head.out_state,
+            launch_wall,
+        )
+        td_wall = time.perf_counter() - t_td
+        overlapped = td_wall if inflight else 0.0
+        stats.chunks += 1
+        stats.launch_seconds += launch_wall
+        stats.touchdown_seconds += td_wall
+        stats.overlap_seconds += overlapped
+        if on_launch is not None:
+            on_launch(
+                seconds=launch_wall,
+                touchdown_seconds=td_wall,
+                overlap_seconds=overlapped,
+                touchdown_hidden_fraction=(
+                    overlapped / td_wall if td_wall > 0 else 0.0
+                ),
+            )
+    return state, stats

@@ -98,40 +98,8 @@ def compute_round_metrics(
     tests/test_telemetry.py).
     """
     from distributed_active_learning_tpu.ops import forest_eval, scoring, trees_multi
-    from distributed_active_learning_tpu.runtime import state as state_lib
 
     with jax.named_scope("al/metrics"):
-        valid = state.valid_mask
-        # Short final windows: when fewer than window_size unlabeled rows
-        # remain, ops/topk.py pads the selection with +/-inf sentinel values
-        # whose indices point at already-labeled rows (reveal treats them as
-        # no-ops). Every statistic below masks to the FINITE picks so the
-        # exhaustion tail yields real numbers, not inf/NaN — which would
-        # poison RoundRecord.metrics and serialize as invalid JSON.
-        finite = jnp.isfinite(picked_vals)
-        n_finite = jnp.maximum(jnp.sum(finite.astype(jnp.int32)), 1)
-        score_min = jnp.min(jnp.where(finite, picked_vals, jnp.inf))
-        score_max = jnp.max(jnp.where(finite, picked_vals, -jnp.inf))
-        score_mean = jnp.sum(jnp.where(finite, picked_vals, 0.0)) / n_finite
-        # Margin to the best unpicked candidate: the score gap across the
-        # selection boundary. Candidates are unlabeled real rows minus the
-        # window just picked; the masked extremum uses the same +/-inf
-        # neutralization as ops/topk.py.
-        remaining = (~state.labeled_mask).at[picked].set(False) & valid
-        if higher_is_better:
-            worst_picked = jnp.min(jnp.where(finite, picked_vals, jnp.inf))
-            best_rest = jnp.max(jnp.where(remaining, scores, -jnp.inf))
-            margin = worst_picked - best_rest
-        else:
-            worst_picked = jnp.max(jnp.where(finite, picked_vals, -jnp.inf))
-            best_rest = jnp.min(jnp.where(remaining, scores, jnp.inf))
-            margin = best_rest - worst_picked
-        # No finite picks / no remaining candidates (pool exhausted mid- or
-        # end-window): report 0 rather than the arithmetic of sentinels.
-        score_min = jnp.where(jnp.isfinite(score_min), score_min, 0.0)
-        score_max = jnp.where(jnp.isfinite(score_max), score_max, 0.0)
-        margin = jnp.where(jnp.isfinite(margin), margin, 0.0)
-
         # Mean predictive entropy over the pool — the classic AL progress
         # signal (falling entropy = the learner is running out of points it
         # is unsure about). Full entropy in bits for both the binary and the
@@ -140,25 +108,98 @@ def compute_round_metrics(
             ent = trees_multi.entropy_multi(trees_multi.proba_multi(forest, state.x))
         else:
             ent = scoring.full_entropy(forest_eval.proba(forest, state.x))
-        ent_mean = jnp.sum(jnp.where(valid, ent, 0.0)) / state.n_valid
+        return selection_metrics(
+            state, picked, picked_vals, scores,
+            higher_is_better=higher_is_better,
+            n_classes=n_classes,
+            pool_entropy=ent,
+        )
 
-        hist = jnp.sum(
-            jax.nn.one_hot(state.oracle_y[picked], n_classes, dtype=jnp.int32)
-            * finite[:, None].astype(jnp.int32),  # sentinel picks count nothing
-            axis=0,
+
+def selection_metrics(
+    state,
+    picked: jnp.ndarray,
+    picked_vals: jnp.ndarray,
+    scores: jnp.ndarray,
+    *,
+    higher_is_better: bool,
+    n_classes: int,
+    pool_entropy: jnp.ndarray,
+) -> RoundMetrics:
+    """Model-agnostic half of :func:`compute_round_metrics` (traced code).
+
+    Everything except the pool-entropy pass is a function of the selection
+    alone — scores, the picked window, and the pre-reveal state — so the
+    NEURAL loop's fused acquire program (runtime/neural_loop.py
+    ``make_neural_chunk_fn``) builds the same :class:`RoundMetrics` pytree by
+    passing its own per-point predictive entropy as ``pool_entropy`` (a
+    ``[n]`` vector, reduced over valid rows here; MC-dropout entropy is in
+    nats where the forest's is in bits — consumers read the unit off the
+    loop kind in the run's ``meta`` event).
+    """
+    with jax.named_scope("al/metrics"):
+        return _selection_metrics(
+            state, picked, picked_vals, scores,
+            higher_is_better, n_classes, pool_entropy,
         )
-        labeled_frac = (
-            state_lib.labeled_count(state).astype(jnp.float32) / state.n_valid
-        )
-        return RoundMetrics(
-            score_min=score_min.astype(jnp.float32),
-            score_mean=score_mean.astype(jnp.float32),
-            score_max=score_max.astype(jnp.float32),
-            score_margin=margin.astype(jnp.float32),
-            pool_entropy=ent_mean.astype(jnp.float32),
-            labeled_frac=labeled_frac,
-            picked_hist=hist,
-        )
+
+
+def _selection_metrics(
+    state, picked, picked_vals, scores,
+    higher_is_better, n_classes, pool_entropy,
+) -> RoundMetrics:
+    from distributed_active_learning_tpu.runtime import state as state_lib
+
+    valid = state.valid_mask
+    # Short final windows: when fewer than window_size unlabeled rows
+    # remain, ops/topk.py pads the selection with +/-inf sentinel values
+    # whose indices point at already-labeled rows (reveal treats them as
+    # no-ops). Every statistic below masks to the FINITE picks so the
+    # exhaustion tail yields real numbers, not inf/NaN — which would
+    # poison RoundRecord.metrics and serialize as invalid JSON.
+    finite = jnp.isfinite(picked_vals)
+    n_finite = jnp.maximum(jnp.sum(finite.astype(jnp.int32)), 1)
+    score_min = jnp.min(jnp.where(finite, picked_vals, jnp.inf))
+    score_max = jnp.max(jnp.where(finite, picked_vals, -jnp.inf))
+    score_mean = jnp.sum(jnp.where(finite, picked_vals, 0.0)) / n_finite
+    # Margin to the best unpicked candidate: the score gap across the
+    # selection boundary. Candidates are unlabeled real rows minus the
+    # window just picked; the masked extremum uses the same +/-inf
+    # neutralization as ops/topk.py.
+    remaining = (~state.labeled_mask).at[picked].set(False) & valid
+    if higher_is_better:
+        worst_picked = jnp.min(jnp.where(finite, picked_vals, jnp.inf))
+        best_rest = jnp.max(jnp.where(remaining, scores, -jnp.inf))
+        margin = worst_picked - best_rest
+    else:
+        worst_picked = jnp.max(jnp.where(finite, picked_vals, -jnp.inf))
+        best_rest = jnp.min(jnp.where(remaining, scores, jnp.inf))
+        margin = best_rest - worst_picked
+    # No finite picks / no remaining candidates (pool exhausted mid- or
+    # end-window): report 0 rather than the arithmetic of sentinels.
+    score_min = jnp.where(jnp.isfinite(score_min), score_min, 0.0)
+    score_max = jnp.where(jnp.isfinite(score_max), score_max, 0.0)
+    margin = jnp.where(jnp.isfinite(margin), margin, 0.0)
+
+    ent_mean = jnp.sum(jnp.where(valid, pool_entropy, 0.0)) / state.n_valid
+
+    hist = jnp.sum(
+        jax.nn.one_hot(state.oracle_y[picked], n_classes, dtype=jnp.int32)
+        * finite[:, None].astype(jnp.int32),  # sentinel picks count nothing
+        axis=0,
+    )
+    labeled_frac = (
+        state_lib.labeled_count(state).astype(jnp.float32) / state.n_valid
+    )
+    return RoundMetrics(
+        score_min=score_min.astype(jnp.float32),
+        score_mean=score_mean.astype(jnp.float32),
+        score_max=score_max.astype(jnp.float32),
+        score_margin=margin.astype(jnp.float32),
+        pool_entropy=ent_mean.astype(jnp.float32),
+        labeled_frac=labeled_frac,
+        picked_hist=hist,
+    )
 
 
 # The one source of truth for the metric field names — the dict converters
@@ -326,10 +367,17 @@ class MetricsWriter:
     """
 
     def __init__(self, path: str, rank: Optional[int] = None):
+        import threading
+
         self.path = path
         self.rank = jax.process_index() if rank is None else rank
         self.counters: Dict[str, float] = {}
         self._f = None
+        # Serializes line writes: the --stream-rounds path emits events from
+        # the jax.debug.callback runtime thread CONCURRENTLY with the main
+        # thread's touchdown events, and two interleaved self._f.write calls
+        # would corrupt the JSONL stream.
+        self._lock = threading.Lock()
         if self._is_primary():
             parent = os.path.dirname(os.path.abspath(path))
             os.makedirs(parent, exist_ok=True)
@@ -356,11 +404,16 @@ class MetricsWriter:
             return
         line = {"ts": round(time.time(), 3), "kind": kind, "rank": self.rank}
         line.update(fields)
-        self._f.write(json.dumps(self._json_safe(line)) + "\n")
-        # Flush per event: the stream's whole point is post-mortem visibility,
-        # and a SIGKILLed/preempted run never reaches close() — event volume
-        # is host-side and low (a handful per touchdown), so this is cheap.
-        self._f.flush()
+        text = json.dumps(self._json_safe(line)) + "\n"
+        with self._lock:
+            if self._f is None:  # closed between the fast check and here
+                return
+            self._f.write(text)
+            # Flush per event: the stream's whole point is post-mortem
+            # visibility, and a SIGKILLed/preempted run never reaches
+            # close() — event volume is host-side and low (a handful per
+            # touchdown), so this is cheap.
+            self._f.flush()
 
     # -- the event vocabulary ------------------------------------------------
 
@@ -405,11 +458,16 @@ class MetricsWriter:
         first_call: bool,
         cache_size: Optional[int] = None,
         recompiled: bool = False,
+        **extra,
     ) -> None:
         """Launch accounting: the first call of a jitted program includes
         tracing + XLA compile, so its wall time is reported separately from
         steady-state executes; ``recompiled`` flags jit-cache growth on a
-        non-first call (the silent recompile cliff)."""
+        non-first call (the silent recompile cliff). ``extra`` carries the
+        pipelined driver's overlap accounting (``touchdown_seconds``,
+        ``overlap_seconds``, ``touchdown_hidden_fraction`` — how much of the
+        chunk's host touchdown ran hidden under another chunk's execution,
+        runtime/pipeline.py)."""
         self.event(
             "launch",
             program=program,
@@ -417,19 +475,25 @@ class MetricsWriter:
             first_call=first_call,
             cache_size=cache_size,
             recompiled=recompiled,
+            **{
+                k: (round(v, 6) if isinstance(v, float) else v)
+                for k, v in extra.items()
+            },
         )
 
     # -- lifecycle -----------------------------------------------------------
 
     def flush(self) -> None:
-        if self._f is not None:
-            self._f.flush()
+        with self._lock:
+            if self._f is not None:
+                self._f.flush()
 
     def close(self) -> None:
-        if self._f is not None:
-            self._f.flush()
-            self._f.close()
-            self._f = None
+        with self._lock:
+            if self._f is not None:
+                self._f.flush()
+                self._f.close()
+                self._f = None
 
     def __enter__(self):
         return self
@@ -453,7 +517,10 @@ class LaunchTracker:
         self.calls = 0
         self._last_cache = None
 
-    def record(self, seconds: float) -> None:
+    def record(self, seconds: float, **extra) -> None:
+        """One launch observation; ``extra`` (e.g. the pipelined driver's
+        ``touchdown_seconds``/``overlap_seconds``/``touchdown_hidden_fraction``)
+        rides the JSONL event verbatim."""
         self.calls += 1
         if self.writer is None:
             return
@@ -471,4 +538,5 @@ class LaunchTracker:
             first_call=self.calls == 1,
             cache_size=cache,
             recompiled=recompiled,
+            **extra,
         )

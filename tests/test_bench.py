@@ -89,6 +89,7 @@ def test_bench_score_mesh_path_pads_odd_pools(bench):
     assert r["kernel"] == "pallas+mesh2x1" and r["value"] > 0
 
 
+@pytest.mark.slow  # ~170s standalone: 4 conv/transformer XLA compiles on CPU
 def test_bench_neural_tiny_pool_keeps_candidates(bench):
     """The window/seed-count clamps must leave real unlabeled candidates on
     tiny smoke pools (the forest-bench --window default is 100)."""

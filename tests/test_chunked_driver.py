@@ -7,6 +7,12 @@ experiment driver (records identical for K that do and don't divide the round
 count, budget stops exact mid-chunk, sharded mesh path) and the raw chunk
 program (picked indices and final labeled mask bit-identical to stepping the
 round function by hand).
+
+Since PR 4, every chunked run here ALSO exercises the pipelined dispatcher at
+its default depth 2 (runtime/pipeline.py: chunk N+1 dispatched before chunk
+N's host touchdown, one speculative chunk past the stop point) — so this
+whole suite doubles as the depth-2 parity evidence; tests/test_pipeline.py
+adds the explicit depth-1/depth-3 arms and the scheduler unit tests.
 """
 
 import jax
@@ -46,13 +52,20 @@ def _assert_records_equal(a, b):
     assert [r.accuracy for r in a.records] == [r.accuracy for r in b.records]
 
 
+# The per-round baselines run ONCE per suite (session/module fixtures) —
+# each parametrization below re-runs only its chunked arm against them.
+@pytest.fixture(scope="module")
+def density_base():
+    return run_experiment(_cfg(1, strategy="density"))
+
+
 # K=1 exercises the config no-op (per-round path), K=4 chunk boundaries
 # landing inside the run, K=7 a chunk that overruns max_rounds=6 — the
 # masked-no-op tail must not add, drop, or perturb records.
 @pytest.mark.parametrize("strategy", ["uncertainty", "density"])
 @pytest.mark.parametrize("k", [1, 4, 7])
-def test_chunked_matches_per_round_driver(k, strategy):
-    base = run_experiment(_cfg(1, strategy=strategy))
+def test_chunked_matches_per_round_driver(k, strategy, forest_device_base, density_base):
+    base = forest_device_base if strategy == "uncertainty" else density_base
     chunked = run_experiment(_cfg(k, strategy=strategy))
     assert len(base.records) == 6
     _assert_records_equal(chunked, base)
@@ -169,10 +182,12 @@ def test_chunk_fn_picked_and_mask_match_manual_rounds():
         strategy, window, K, device_fit, label_cap=state0.n_valid, donate=False
     )
     end_round = jnp.int32(np.iinfo(np.int32).max)
-    chunk_state, (rounds_y, labeled_y, _acc_y, picked_y, active_y) = chunk_fn(
+    chunk_state, extras, (rounds_y, labeled_y, _acc_y, picked_y, active_y) = chunk_fn(
         binned.codes, state0, aux, fit_key, tx, ty, end_round
     )
     assert bool(np.asarray(active_y).all())  # cap/end never hit in K rounds
+    # The pipelined driver's stop scalars must agree with the stacked ys.
+    assert int(extras.n_active) == K
 
     st = state0
     for i in range(K):
@@ -182,6 +197,9 @@ def test_chunk_fn_picked_and_mask_match_manual_rounds():
         st, picked, _ = round_fn(forest, st, aux)
         np.testing.assert_array_equal(np.asarray(picked_y)[i], np.asarray(picked))
         assert int(np.asarray(rounds_y)[i]) == int(st.round)
+    assert int(extras.n_labeled_after) == int(
+        np.asarray(st.labeled_mask).sum()
+    )
     np.testing.assert_array_equal(
         np.asarray(chunk_state.labeled_mask), np.asarray(st.labeled_mask)
     )
@@ -210,14 +228,14 @@ def test_chunked_driver_donates_without_warnings():
     assert donation_warnings == []
 
 
-def test_chunked_enabled_debugger_no_longer_falls_back():
+def test_chunked_enabled_debugger_no_longer_falls_back(forest_device_base):
     """Pre-telemetry, an enabled Debugger (phase_detail defaulted to
     enabled) silently cost every logged run its scan fusion. Now only an
     explicit phase_detail=True does; a merely-enabled debugger keeps the
     chunked driver (zero per-round phase splits) with identical records."""
     from distributed_active_learning_tpu.runtime.debugger import Debugger
 
-    base = run_experiment(_cfg(1))
+    base = forest_device_base
     fused = run_experiment(
         _cfg(4), debugger=Debugger(enabled=True, printer=lambda *a: None)
     )

@@ -94,6 +94,8 @@ def _tiny_images_entry(cfg):
     )
 
 
+@pytest.mark.slow  # conv-net XLA compile dominates on CPU (~15s+); SmallCNN
+# coverage stays in-window via test_deep.test_neural_loop_cnn_image_shape
 def test_cli_cnn_model_end_to_end(capsys):
     from distributed_active_learning_tpu.data.datasets import _REGISTRY
 
@@ -112,6 +114,8 @@ def test_cli_cnn_model_end_to_end(capsys):
     assert len(lines) == 2 and lines[-1]["n_labeled"] == 30
 
 
+@pytest.mark.slow  # transformer + batchbald compile (~22s); encoder coverage
+# stays in-window via test_ring_attention.test_text_al_loop_with_transformer
 def test_cli_transformer_model_end_to_end(capsys):
     rc = main([
         "--dataset", "agnews", "--neural", "--model", "transformer",

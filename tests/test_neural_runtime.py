@@ -197,6 +197,7 @@ def test_neural_al_accuracy_improves_over_rounds():
     assert max(accs) > 0.93, f"never near-solved: {accs}"
 
 
+@pytest.mark.slow  # ~130s standalone: 3 strategies x 3 seeds x 8 AL rounds
 def test_neural_strategy_beats_random_auc():
     """Falsifiable strategy-beats-random regression on the NEURAL path — the
     counterpart of the forest path's strict US-beats-RAND test

@@ -1,0 +1,348 @@
+"""Pipelined chunk dispatch (runtime/pipeline.py): bit-identical parity.
+
+The pipelined driver exists purely to hide host touchdowns behind device
+execution (dispatch-ahead-of-data); it must never change results. Pinned
+here at both levels: the experiment drivers (forest AND the newly scan-fused
+neural loop — depth 2 vs depth 1 vs the per-round driver, mid-chunk budget
+stops, checkpoint resume mid-pipeline, the 4x2 / 8-way meshes) and the raw
+``run_pipelined`` scheduler (dispatch ordering, one-chunk speculation,
+serial-order depth 1, overlap accounting).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from distributed_active_learning_tpu.config import (
+    DataConfig,
+    ExperimentConfig,
+    ForestConfig,
+    StrategyConfig,
+)
+from distributed_active_learning_tpu.runtime.loop import run_experiment
+from distributed_active_learning_tpu.runtime.pipeline import (
+    ChunkExtras,
+    run_pipelined,
+)
+
+
+# ---------------------------------------------------------------------------
+# Scheduler unit tests: fake (host-only) chunks, no jax programs involved.
+# ---------------------------------------------------------------------------
+
+
+def _fake_chunk(state, k_active):
+    """One fake chunk advancing ``state`` (a plain int) by ``k_active`` rounds."""
+    new_state = state + k_active
+    return new_state, ChunkExtras(
+        n_labeled_after=np.int32(new_state), n_active=np.int32(k_active)
+    ), {"rounds": list(range(state, new_state))}
+
+
+def _drive(depth, total_rounds, k=3):
+    calls = []
+    touched = []
+    done = {"rounds": 0}
+
+    def dispatch(state, idx):
+        calls.append(("dispatch", idx))
+        left = max(min(total_rounds - state, k), 0)
+        return _fake_chunk(state, left)
+
+    def continue_after(n_labeled_after, n_active):
+        # Mirrors the real drivers: a short chunk means an in-chunk stop, and
+        # the rounds-done tally catches the exactly-divisible max_rounds case.
+        done["rounds"] += n_active
+        return n_active == k and done["rounds"] < total_rounds
+
+    def touchdown(idx, nla, n_active, ys, out_state, wall):
+        calls.append(("touchdown", idx))
+        touched.extend(ys["rounds"])
+
+    final, stats = run_pipelined(
+        0, dispatch=dispatch, touchdown=touchdown,
+        continue_after=continue_after, depth=depth,
+    )
+    return calls, touched, final, stats
+
+
+def test_depth1_is_strict_serial_order():
+    """depth=1 must interleave dispatch/touchdown strictly — the exact
+    pre-pipeline driver order, with zero overlap recorded."""
+    calls, touched, final, stats = _drive(depth=1, total_rounds=8)
+    assert calls == [
+        ("dispatch", 0), ("touchdown", 0),
+        ("dispatch", 1), ("touchdown", 1),
+        ("dispatch", 2), ("touchdown", 2),  # the stopping (short) chunk
+    ]
+    assert touched == list(range(8))
+    assert stats.overlap_seconds == 0.0
+    assert stats.touchdown_hidden_fraction == 0.0
+
+
+def test_depth2_speculates_exactly_one_chunk():
+    """depth=2 dispatches ahead of every touchdown (chunk N+2 launches the
+    moment chunk N completes, BEFORE chunk N's host bookkeeping runs), and
+    exactly one speculative chunk runs past the stop point."""
+    calls, touched, final, stats = _drive(depth=2, total_rounds=6)
+    # Chunks 0,1 full (3 rounds each); chunk 2 is dispatched speculatively
+    # before chunk 0's touchdown (chunk 1's outcome unknown), turns out
+    # empty, and is the last.
+    assert calls == [
+        ("dispatch", 0), ("dispatch", 1),
+        ("dispatch", 2), ("touchdown", 0),
+        ("touchdown", 1),
+        ("touchdown", 2),
+    ]
+    assert touched == list(range(6))  # the speculative chunk added nothing
+    assert final == 6
+
+
+def test_touchdowns_stay_in_chunk_order_at_depth3():
+    calls, touched, _final, _stats = _drive(depth=3, total_rounds=12)
+    td = [i for kind, i in calls if kind == "touchdown"]
+    assert td == sorted(td)
+    assert touched == list(range(12))
+
+
+def test_depth_zero_rejected():
+    with pytest.raises(ValueError, match="depth"):
+        run_pipelined(
+            0, dispatch=None, touchdown=None,
+            continue_after=None, depth=0,
+        )
+
+
+def test_may_dispatch_veto_skips_provably_inactive_chunks():
+    """With an a-priori bound (may_dispatch), depth 2 never launches the
+    speculative chunk: exactly total/k chunks dispatch, in order, and results
+    match the unvetoed drive."""
+    calls = []
+    touched = []
+    done = {"rounds": 0}
+    k, total = 3, 9
+
+    def dispatch(state, idx):
+        calls.append(("dispatch", idx))
+        return _fake_chunk(state, min(total - state, k))
+
+    def continue_after(nla, n_active):
+        done["rounds"] += n_active
+        return n_active == k and done["rounds"] < total
+
+    def touchdown(idx, nla, n_active, ys, out_state, wall):
+        calls.append(("touchdown", idx))
+        touched.extend(ys["rounds"])
+
+    final, stats = run_pipelined(
+        0, dispatch=dispatch, touchdown=touchdown,
+        continue_after=continue_after, depth=2,
+        may_dispatch=lambda idx: idx * k < total,
+    )
+    assert [i for kind, i in calls if kind == "dispatch"] == [0, 1, 2]
+    assert touched == list(range(total)) and final == total
+    assert stats.chunks == 3  # no speculative 4th launch
+
+
+def test_overlap_accounting_counts_inflight_touchdowns():
+    """With depth 2 every touchdown except the drain-phase last one runs with
+    a chunk in flight, so the hidden fraction lands strictly between 0 and 1
+    (1.0 exactly would need the final touchdown to overlap too)."""
+    _calls, _touched, _final, stats = _drive(depth=2, total_rounds=30)
+    assert 0.0 < stats.touchdown_hidden_fraction < 1.0
+    assert stats.overlap_seconds <= stats.touchdown_seconds
+    assert stats.chunks == 11  # 10 full + 1 speculative
+
+
+# ---------------------------------------------------------------------------
+# Forest loop: pipelined (depth 2) vs serial (depth 1) vs per-round.
+# ---------------------------------------------------------------------------
+
+
+def _forest_cfg(k, depth, **kw):
+    return ExperimentConfig(
+        data=DataConfig(name="checkerboard2x2", seed=3),
+        forest=kw.pop(
+            "forest", ForestConfig(n_trees=10, max_depth=4, fit="device")
+        ),
+        strategy=StrategyConfig(name="uncertainty", window_size=20),
+        n_start=10,
+        max_rounds=kw.pop("max_rounds", 6),
+        seed=kw.pop("seed", 0),
+        rounds_per_launch=k,
+        pipeline_depth=depth,
+        **kw,
+    )
+
+
+def _assert_records_equal(a, b):
+    assert [r.round for r in a.records] == [r.round for r in b.records]
+    assert [r.n_labeled for r in a.records] == [r.n_labeled for r in b.records]
+    # Bit-identical, not allclose: pipelining only reorders HOST work; the
+    # device programs are the same chunk launches in the same sequence.
+    assert [r.accuracy for r in a.records] == [r.accuracy for r in b.records]
+
+
+# NOTE on forest-loop coverage: ExperimentConfig.pipeline_depth defaults to
+# 2, so the whole tests/test_chunked_driver.py suite ALREADY exercises the
+# depth-2 pipelined driver against per-round baselines — chunk sizes that do
+# and don't divide the round count, mid-chunk budget stops, checkpoint
+# resume mid-pipeline, and the 4x2 sharded mesh. This file adds only what
+# that suite cannot: the explicit depth-1 (serial-order) arm and depth >
+# chunk-count, both pinned against the SAME shared per-round baseline, which
+# transitively pins depth 1 == depth 2 bit-for-bit.
+
+
+def test_forest_serial_depth1_and_deep_depth_match_per_round(forest_device_base):
+    serial = run_experiment(_forest_cfg(4, 1))  # strict launch->block->touchdown
+    deep3 = run_experiment(_forest_cfg(7, 3))   # depth > chunk count also exact
+    assert len(forest_device_base.records) == 6
+    _assert_records_equal(serial, forest_device_base)
+    _assert_records_equal(deep3, forest_device_base)
+
+
+# ---------------------------------------------------------------------------
+# Neural loop: scan-fused + pipelined vs the per-round loop.
+# ---------------------------------------------------------------------------
+
+
+def _neural_pool(n=240, d=6, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    y = (x[:, 0] + 0.5 * x[:, 1] > 0).astype(np.int32)
+    tx = rng.normal(size=(64, d)).astype(np.float32)
+    ty = (tx[:, 0] + 0.5 * tx[:, 1] > 0).astype(np.int32)
+    return x, y, tx, ty
+
+
+def _neural_run(k, depth, strategy="bald", **kw):
+    from distributed_active_learning_tpu.models.neural import MLP, NeuralLearner
+    from distributed_active_learning_tpu.runtime.neural_loop import (
+        NeuralExperimentConfig,
+        run_neural_experiment,
+    )
+
+    x, y, tx, ty = _neural_pool()
+    learner = NeuralLearner(
+        MLP(n_classes=2, hidden=(16,)), (6,), train_steps=25, mc_samples=3
+    )
+    cfg = NeuralExperimentConfig(
+        strategy=strategy,
+        window_size=10,
+        n_start=12,
+        max_rounds=kw.pop("max_rounds", 4),
+        seed=7,
+        rounds_per_launch=k,
+        pipeline_depth=depth,
+        **kw,
+    )
+    return run_neural_experiment(cfg, learner, x, y, tx, ty)
+
+
+@pytest.fixture(scope="module")
+def neural_per_round():
+    return _neural_run(1, 1)
+
+
+@pytest.mark.parametrize("strategy", ["bald", "random"])
+def test_neural_fused_matches_per_round(neural_per_round, strategy):
+    base = (
+        neural_per_round if strategy == "bald" else _neural_run(1, 1, strategy)
+    )
+    fused = _neural_run(3, 2, strategy)
+    assert len(base.records) == 4
+    _assert_records_equal(fused, base)
+
+
+def test_neural_fused_budget_stop_mid_chunk():
+    base = _neural_run(1, 1, label_budget=35, max_rounds=50)
+    fused = _neural_run(3, 2, label_budget=35, max_rounds=50)
+    _assert_records_equal(fused, base)
+    assert fused.records[-1].n_labeled < 35
+
+
+def test_neural_fused_checkpoint_resume(tmp_path):
+    """Neural chunk touchdowns persist (net, state, key) from the un-donated
+    carry; a mid-pipeline save must resume bit-identically vs the FUSED
+    uninterrupted run and match the per-round curve."""
+    full = _neural_run(1, 1, max_rounds=6)
+    ckpt = os.path.join(tmp_path, "nck")
+    _neural_run(2, 2, max_rounds=3, checkpoint_dir=ckpt, checkpoint_every=1)
+    resumed = _neural_run(
+        2, 2, max_rounds=3, checkpoint_dir=ckpt, checkpoint_every=1
+    )
+    assert [r.round for r in resumed.records] == list(range(1, 7))
+    assert [r.accuracy for r in resumed.records] == [
+        r.accuracy for r in full.records
+    ]
+
+
+def test_neural_fused_on_data_mesh(devices):
+    """Fused + pipelined neural loop on the 8-way data mesh == single-device
+    per-round (240 rows divide 8: no padding, literally the same program)."""
+    from distributed_active_learning_tpu.config import MeshConfig as MC
+
+    base = _neural_run(1, 1, max_rounds=3)
+    fused = _neural_run(2, 2, max_rounds=3, mesh=MC(data=8))
+    assert [r.n_labeled for r in fused.records] == [
+        r.n_labeled for r in base.records
+    ]
+    np.testing.assert_allclose(
+        [r.accuracy for r in fused.records],
+        [r.accuracy for r in base.records],
+        atol=1e-5,
+    )
+
+
+def test_neural_unfusable_strategy_falls_back():
+    """batchbald's greedy unrolled acquire keeps the per-round loop:
+    rounds_per_launch > 1 must silently fall back, not fail, and produce the
+    per-round curve (with real per-phase timings as the fallback marker)."""
+    base = _neural_run(1, 1, "batchbald", max_rounds=2)
+    fused = _neural_run(3, 2, "batchbald", max_rounds=2)
+    _assert_records_equal(fused, base)
+    assert all(r.train_time > 0 for r in fused.records)
+
+
+def test_neural_fused_metrics_ride_the_scan(tmp_path):
+    """With a MetricsWriter attached, the fused neural loop's round events
+    carry the in-scan RoundMetrics (the ROADMAP follow-up: previously the
+    neural path had host-side round events only)."""
+    import json
+
+    from distributed_active_learning_tpu.runtime.telemetry import MetricsWriter
+
+    path = os.path.join(tmp_path, "m.jsonl")
+    with MetricsWriter(path) as w:
+        from distributed_active_learning_tpu.models.neural import (
+            MLP,
+            NeuralLearner,
+        )
+        from distributed_active_learning_tpu.runtime.neural_loop import (
+            NeuralExperimentConfig,
+            run_neural_experiment,
+        )
+
+        x, y, tx, ty = _neural_pool()
+        learner = NeuralLearner(
+            MLP(n_classes=2, hidden=(16,)), (6,), train_steps=25, mc_samples=3
+        )
+        cfg = NeuralExperimentConfig(
+            strategy="bald", window_size=10, n_start=12, max_rounds=3,
+            seed=7, rounds_per_launch=3, pipeline_depth=2,
+        )
+        res = run_neural_experiment(cfg, learner, x, y, tx, ty, metrics=w)
+    events = [json.loads(l) for l in open(path)]
+    rounds = [e for e in events if e["kind"] == "round"]
+    assert [e["round"] for e in rounds] == [1, 2, 3]
+    for e in rounds:
+        assert "pool_entropy" in e and "score_margin" in e
+        assert sum(e["picked_hist"]) == 10
+    launches = [e for e in events if e["kind"] == "launch"]
+    assert launches and all("touchdown_hidden_fraction" in e for e in launches)
+    # Records carry the same metric dicts the JSONL stream saw.
+    assert res.records[0].metrics is not None
+    assert rounds[0]["pool_entropy"] == pytest.approx(
+        res.records[0].metrics["pool_entropy"]
+    )

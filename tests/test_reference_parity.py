@@ -149,6 +149,7 @@ def _lal_auc(strategy, seed, rounds=50):
     return np.mean([r.accuracy for r in run_experiment(cfg).records])
 
 
+@pytest.mark.slow  # ~120s standalone: 3 strategies x 2 seeds x 30-round runs
 def test_lal_is_us_competitive_on_reference_fixtures():
     """r3's LAL curve hovered at ~70% because its regressor was fit on ~160
     synthesized rows; trained on the committed reference-scale dataset
