@@ -698,6 +698,82 @@ def _bench_pipelined(args, chunk_fn, state0, aux, binned, fit_key, tx, ty, K, wi
     }
 
 
+def bench_sweep(args):
+    """Batched-vs-serial experiment sweep throughput (the PR-5 tentpole).
+
+    Advances E experiments by K rounds over ONE shared pool two ways, both
+    through the PRODUCTION drivers: ``runtime.sweep.run_sweep`` (the chunk
+    program vmapped over a leading experiment axis — one trace, one compile,
+    one launch stream for the whole batch) versus the serial E-run loop
+    (``runtime.loop.run_experiment`` once per seed — the pre-sweep status
+    quo, where every run re-traces and re-compiles its own chunk closure,
+    exactly what a for-loop over seeds or the old per-process shard recipe
+    pays). Both arms share the pre-built bundle, so the comparison isolates
+    the drive itself; experiments*rounds per second is the headline.
+    """
+    import dataclasses
+
+    from distributed_active_learning_tpu.config import (
+        DataConfig,
+        ExperimentConfig,
+        ForestConfig,
+        StrategyConfig,
+    )
+    from distributed_active_learning_tpu.data.datasets import DataBundle
+    from distributed_active_learning_tpu.runtime.loop import run_experiment
+    from distributed_active_learning_tpu.runtime.sweep import run_sweep
+
+    E = args.sweep_experiments
+    K = max(int(getattr(args, "rounds_per_launch", 1) or 1), 1)
+    n = args.sweep_pool
+    window = min(args.window, max(n // (4 * K), 1))
+
+    rng = np.random.default_rng(0)
+    pool = rng.normal(size=(n, args.features)).astype(np.float32)
+    pool_y = (pool[:, 0] + 0.3 * pool[:, 1] > 0).astype(np.int32)
+    test = rng.normal(size=(min(n, 2048), args.features)).astype(np.float32)
+    test_y = (test[:, 0] + 0.3 * test[:, 1] > 0).astype(np.int32)
+    bundle = DataBundle(
+        train_x=pool, train_y=pool_y, test_x=test, test_y=test_y,
+        name="bench_sweep",
+    )
+
+    # Depth 4 (not the scoring benches' 8): a sweep's per-round cost is
+    # fit-dominated and both arms share the shape — the smoke deadline
+    # matters more than forest size here.
+    cfg = ExperimentConfig(
+        data=DataConfig(name="bench_sweep"),
+        forest=ForestConfig(
+            n_trees=args.trees, max_depth=4, kernel=args.kernel, fit="device",
+            fit_budget=1 << (window + (K + 1) * window).bit_length(),
+        ),
+        strategy=StrategyConfig(name="uncertainty", window_size=window),
+        n_start=window,
+        max_rounds=K,
+        rounds_per_launch=K,
+        log_every=0,
+    )
+    seeds = list(range(E))
+
+    t0 = time.perf_counter()
+    for s in seeds:
+        run_experiment(dataclasses.replace(cfg, seed=s), bundle=bundle)
+    serial_sec = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    run_sweep(cfg, seeds, bundle=bundle)
+    sweep_sec = time.perf_counter() - t0
+    er = E * K
+    return {
+        "sweep_experiments": E,
+        "sweep_rounds_per_launch": K,
+        "sweep_pool": n,
+        "sweep_window": window,
+        "sweep_experiments_rounds_per_second": round(er / sweep_sec, 2),
+        "serial_experiments_rounds_per_second": round(er / serial_sec, 2),
+        "sweep_speedup": round(serial_sec / sweep_sec, 2),
+    }
+
+
 def bench_lal(args):
     """One LAL query at reference scale: 50-tree base forest, 2000-tree
     regressor, 1000-point pool (``classes/RESULTS.txt``)."""
@@ -923,6 +999,21 @@ def _run_mode(args) -> dict:
             "vs_baseline": None,
             **{k: v for k, v in r.items() if k != "cnn_round_seconds"},
         }
+    if args.mode == "sweep":
+        r = bench_sweep(args)
+        return {
+            "metric": "sweep_experiments_rounds_per_second",
+            "value": r["sweep_experiments_rounds_per_second"],
+            "unit": (
+                f"experiments*rounds/s ({r['sweep_experiments']} experiments "
+                f"x {r['sweep_rounds_per_launch']} rounds, {r['sweep_pool']} "
+                "pool, batched sweep chunk vs serial E-run loop)"
+            ),
+            "vs_baseline": None,
+            # the full key set rides too (the CI smoke job and cross-round
+            # diffs key on sweep_experiments_rounds_per_second by name)
+            **r,
+        }
     if args.mode == "round":
         r = bench_round(args)
         return {
@@ -955,7 +1046,10 @@ def _run_mode(args) -> dict:
     # is skipped up front — the between-modes check alone let a 4-minute
     # neural compile start at deadline-minus-epsilon and blow the outer
     # timeout anyway. On TPU the modes run in seconds, so no pre-estimates.
-    _cpu_cost = {"score": 30, "density": 25, "round": 220, "lal": 30, "neural": 260}
+    _cpu_cost = {
+        "score": 30, "density": 25, "round": 220, "sweep": 90, "lal": 30,
+        "neural": 260,
+    }
 
     def want(name):
         if not deadline:
@@ -1024,6 +1118,9 @@ def _run_mode(args) -> dict:
             # Memory watermarks ride only when the backend reports them (TPU).
             **{k: v for k, v in rd.items() if k.startswith("device_")},
         })
+    if want("sweep"):
+        sw = bench_sweep(args)
+        out.update(sw)
     if want("lal"):
         ll = bench_lal(args)
         out.update({
@@ -1112,6 +1209,8 @@ _TPU_SIZES = dict(
     neural_pool=2000,
     train_steps=300,
     rounds_per_launch=8,
+    sweep_experiments=8,
+    sweep_pool=100_000,
 )
 _CPU_SIZES = dict(
     pool=10_000,
@@ -1123,6 +1222,8 @@ _CPU_SIZES = dict(
     neural_pool=200,
     train_steps=25,
     rounds_per_launch=4,
+    sweep_experiments=8,
+    sweep_pool=500,
 )
 
 
@@ -1140,11 +1241,27 @@ def _resolve_sizes(args) -> bool:
     return cpu
 
 
+def _trace_phases(profile_dir: str) -> dict:
+    """Parse a --profile-dir capture into per-phase device seconds via the
+    trace parser in benches/summarize_metrics.py (loaded by path — `benches`
+    is a script directory, not a package)."""
+    import importlib.util
+
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "benches", "summarize_metrics.py",
+    )
+    spec = importlib.util.spec_from_file_location("summarize_metrics", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.device_seconds_by_phase(profile_dir)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument(
         "--mode",
-        choices=["all", "score", "density", "round", "lal", "neural"],
+        choices=["all", "score", "density", "round", "sweep", "lal", "neural"],
         default="all",
     )
     # Size flags default to None = backend-resolved (_resolve_sizes): the
@@ -1161,6 +1278,21 @@ def main():
     ap.add_argument("--train-rows", type=int, default=None)
     ap.add_argument("--lal-trees", type=int, default=None)
     ap.add_argument("--lal-pool", type=int, default=None)
+    ap.add_argument(
+        "--sweep-experiments", type=int, default=None,
+        help="sweep mode: experiments batched over the leading vmap axis "
+        "(default 8)",
+    )
+    ap.add_argument(
+        "--sweep-pool", type=int, default=None,
+        help="sweep mode: shared pool rows (backend-resolved default)",
+    )
+    ap.add_argument(
+        "--profile-dir", default=None, metavar="DIR",
+        help="capture a jax.profiler trace of the whole bench run into DIR "
+        "and fold per-phase DEVICE seconds (keyed on the jax.named_scope "
+        "phase names) back into the JSON as device_seconds_by_phase",
+    )
     ap.add_argument(
         "--mesh-data", type=int, default=0,
         help="score through the mesh path: shard pool rows over a "
@@ -1216,7 +1348,20 @@ def main():
     cpu_sizes = False
     try:
         cpu_sizes = _resolve_sizes(args)
-        payload = run_with_health(args)
+        if args.profile_dir:
+            # Whole-suite jax.profiler capture; afterwards the trace's
+            # op-level timeline folds back onto the named_scope phase names
+            # (benches/summarize_metrics.py) so the JSON carries per-phase
+            # DEVICE time next to the wall numbers (ROADMAP PR-3 follow-up).
+            from distributed_active_learning_tpu.runtime.telemetry import (
+                profile_session,
+            )
+
+            with profile_session(args.profile_dir):
+                payload = run_with_health(args)
+            payload["device_seconds_by_phase"] = _trace_phases(args.profile_dir)
+        else:
+            payload = run_with_health(args)
         rc = 0
     except BaseException as e:  # noqa: BLE001 — the JSON line must print
         payload = {

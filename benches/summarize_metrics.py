@@ -21,9 +21,83 @@ Sections:
 from __future__ import annotations
 
 import argparse
+import gzip
 import json
+import os
+import re
 import sys
 from typing import Dict, List
+
+
+# The jax.named_scope phase labels the codebase stamps on its hot paths
+# (runtime/loop.py al/*, ops/trees_train.py trees/*, ops/forest_eval.py
+# forest/*, parallel/kernels.py shard/*, models/neural.py neural/*). A trace
+# event is attributed to its INNERMOST (last-appearing) scope — see
+# device_seconds_by_phase — so nested scopes never double-count an op.
+_PHASE_RE = re.compile(r"\b((?:al|trees|forest|shard|neural)/[A-Za-z0-9_]+)")
+
+
+def find_trace_files(profile_dir: str) -> List[str]:
+    """Locate chrome-trace JSON files under a ``--profile-dir`` capture.
+
+    ``jax.profiler`` writes ``<dir>/plugins/profile/<run>/<host>.trace.json.gz``
+    (TensorBoard layout); plain ``*.trace.json`` is accepted too so synthetic
+    or hand-exported traces parse the same way.
+    """
+    out = []
+    for root, _dirs, files in os.walk(profile_dir):
+        for fn in files:
+            if fn.endswith((".trace.json.gz", ".trace.json")):
+                out.append(os.path.join(root, fn))
+    return sorted(out)
+
+
+def _load_trace(path: str) -> dict:
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt") as f:
+        return json.load(f)
+
+
+def device_seconds_by_phase(profile_dir: str) -> Dict[str, float]:
+    """Per-phase DEVICE seconds from a ``--profile-dir`` trace capture.
+
+    Folds the profiler's op-level timeline back onto the ``jax.named_scope``
+    phase names (ROADMAP PR-3 follow-up): every complete event (``"ph":
+    "X"``) naming an OP inside a known scope contributes its ``dur``
+    (microseconds) to that phase's total. Two rules keep totals from
+    double-counting: (1) scopes nest (``al/score`` may contain
+    ``forest/votes``) — an event is charged to its INNERMOST (last) scope, so
+    callers can re-aggregate by prefix; (2) only op rows count — an event
+    whose path ENDS at the scope (TensorBoard's name-scope lane spans, whose
+    duration already covers the child op rows) is skipped, otherwise a TPU
+    capture carrying both lanes would report each phase roughly twice.
+    Returns ``{}`` when the directory holds no trace (e.g. profiling was off)
+    — consumers treat the keys as optional.
+    """
+    totals: Dict[str, float] = {}
+    for path in find_trace_files(profile_dir):
+        try:
+            trace = _load_trace(path)
+        except (OSError, ValueError):
+            continue
+        for ev in trace.get("traceEvents", []):
+            if ev.get("ph") != "X" or "dur" not in ev:
+                continue
+            hay = ev.get("name", "")
+            args = ev.get("args")
+            if isinstance(args, dict):
+                hay = " ".join(
+                    [hay] + [str(v) for v in args.values() if isinstance(v, str)]
+                )
+            last = None
+            for m in _PHASE_RE.finditer(hay):
+                last = m
+            # op rows continue past the scope ("al/score/fusion.3"); a path
+            # that ends AT the scope is a scope-aggregation span — skip it.
+            if last is not None and last.end() < len(hay) and hay[last.end()] == "/":
+                phase = last.group(1)
+                totals[phase] = totals.get(phase, 0.0) + float(ev["dur"]) / 1e6
+    return {k: round(v, 6) for k, v in sorted(totals.items())}
 
 
 def load_events(path: str) -> List[dict]:
@@ -167,9 +241,27 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="Summarize a --metrics-out JSONL stream into per-phase tables"
     )
-    ap.add_argument("path", help="metrics JSONL file (run.py --metrics-out)")
+    ap.add_argument(
+        "path", nargs="?", default=None,
+        help="metrics JSONL file (run.py --metrics-out)",
+    )
+    ap.add_argument(
+        "--trace-dir", default=None, metavar="DIR",
+        help="also (or only) parse a --profile-dir trace capture into "
+        "per-phase DEVICE seconds keyed on the jax.named_scope names",
+    )
     args = ap.parse_args(argv)
-    print(summarize(load_events(args.path)))
+    if args.path is None and args.trace_dir is None:
+        ap.error("need a metrics JSONL path and/or --trace-dir")
+    if args.path is not None:
+        print(summarize(load_events(args.path)))
+    if args.trace_dir is not None:
+        phases = device_seconds_by_phase(args.trace_dir)
+        if not phases:
+            print("\n== device phases ==\n(no trace events found)")
+        else:
+            rows = [[k, f"{v:.4f}"] for k, v in phases.items()]
+            print("\n== device phases ==\n" + _table(["scope", "device s"], rows))
     return 0
 
 

@@ -148,6 +148,15 @@ class ExperimentConfig:
     # the exact fallback used for host fit / --phase-detail). Performance-
     # only, like rounds_per_launch; takes effect when rounds_per_launch > 1.
     pipeline_depth: int = 2
+    # Batched experiment sweep width (runtime/sweep.py): values > 1 run that
+    # many seeds (cfg.seed, cfg.seed+1, ...) as ONE vmapped launch stream —
+    # the chunk program batched over a leading experiment axis sharing the
+    # pool, with per-seed results bit-identical to serial runs. Performance-
+    # only like rounds_per_launch; run.py routes --sweep-seeds N > 1 to
+    # runtime.sweep.run_sweep (host fit / --phase-detail fall back to N
+    # serial runs). Excluded from checkpoint identity; sweep checkpoints
+    # carry their own seed-vector fingerprint.
+    sweep_seeds: int = 1
     # Stream per-round events to the MetricsWriter from INSIDE a running
     # chunk via jax.debug.callback ("round_stream" JSONL events), instead of
     # only at chunk touchdowns. Off by default: the flag adds a host callback

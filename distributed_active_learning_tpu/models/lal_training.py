@@ -13,19 +13,23 @@ method over the simulated unbalanced Gaussians (``classes/test.py:150-187``):
   compute its 5 features; add it to the labeled set, refit, re-measure;
   the regression target is the error reduction.
 
-This module reproduces that procedure (host-side sklearn, one-time offline
-cost), or loads a pre-synthesized reference-format text file, and packs the
-fitted regressor for single-launch device scoring.
+This module reproduces that procedure — since the batched-sweep PR as ONE
+vmapped device program per batch of experiments (the ``runtime/sweep.py``
+discipline applied to the MC set: every experiment's fit/refit/error-eval is
+the device histogram trainer, batched over a leading experiment axis and an
+inner candidate axis) — or loads a pre-synthesized reference-format text
+file, and packs the fitted regressor for single-launch device scoring.
 """
 
 from __future__ import annotations
 
+import functools
 import json
 from typing import Mapping, Optional, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
-from sklearn.ensemble import RandomForestClassifier
 
 from distributed_active_learning_tpu.config import ForestConfig
 from distributed_active_learning_tpu.data.formats import _text_to_matrix
@@ -33,40 +37,69 @@ from distributed_active_learning_tpu.data.synthetic import make_gaussian_unbalan
 from distributed_active_learning_tpu.models.forest import fit_forest_regressor
 from distributed_active_learning_tpu.ops.trees import PackedForest
 
-
-def _tree_votes(model: RandomForestClassifier, x: np.ndarray) -> np.ndarray:
-    """Per-tree positive votes ``[T, n]`` (host twin of the device kernel)."""
-    pos_col = list(model.classes_).index(1) if 1 in model.classes_ else None
-    if pos_col is None:
-        return np.zeros((len(model.estimators_), x.shape[0]))
-    return np.stack(
-        [est.predict_proba(x)[:, pos_col] > 0.5 for est in model.estimators_]
-    ).astype(np.float64)
+_LAL_BINS = 32  # MLlib's maxBins default, like the AL loop's device fit
 
 
-def _lal_point_features(
-    model: RandomForestClassifier,
-    candidate: np.ndarray,
-    labeled_y: np.ndarray,
-    pool_x: np.ndarray,
-    f6: Optional[float] = None,
-) -> np.ndarray:
-    """The 5 LAL features for one candidate point (host/numpy twin of
-    ``strategies.lal.lal_features``; order f_1, f_2, f_3, f_6, f_8 per
-    ``active_learner.py:280-296``). ``f6`` (the pool-level mean vote SD) is
-    candidate-independent — callers scoring many candidates of one pool pass
-    it precomputed."""
-    votes_cand = _tree_votes(model, candidate[None, :])[:, 0]
-    n_trees = len(model.estimators_)
-    f1 = votes_cand.mean()
-    p = votes_cand.sum() / n_trees
-    f2 = np.sqrt(p * (1 - p))
-    f3 = float((labeled_y == 1).mean()) if len(labeled_y) else 0.0
-    if f6 is None:
-        p_pool = _tree_votes(model, pool_x).mean(axis=0)
-        f6 = float(np.sqrt(p_pool * (1 - p_pool)).mean())
-    f8 = float(len(labeled_y))
-    return np.array([f1, f2, f3, f6, f8], dtype=np.float32)
+@functools.partial(
+    jax.jit, static_argnames=("n_trees", "max_depth")
+)
+def _lal_mc_batch(
+    xs: jnp.ndarray,        # [E, n, d] per-experiment pools
+    ys: jnp.ndarray,        # [E, n] labels
+    exs: jnp.ndarray,       # [E, m, d] held-out sets
+    eys: jnp.ndarray,       # [E, m]
+    masks: jnp.ndarray,     # [E, n] bool — the random labeled subsets
+    cands: jnp.ndarray,     # [E, C] int32 — candidate pool indices
+    keys: jax.Array,        # [E] fit keys
+    n_trees: int,
+    max_depth: int,
+):
+    """One batch of simulated AL experiments as a single device program.
+
+    vmapped over experiments; per experiment: bin the pool, fit the base
+    forest on the labeled subset, measure held-out error, read the 5 LAL
+    features for every candidate off the shared feature kernel
+    (``strategies.lal.lal_features``), then — vmapped over candidates —
+    refit with the candidate added and measure the error reduction.
+    """
+    from distributed_active_learning_tpu.ops import forest_eval, trees_train
+    from distributed_active_learning_tpu.runtime import state as state_lib
+    from distributed_active_learning_tpu.strategies.lal import lal_features
+
+    def _err(forest, ex, ey):
+        pred = (forest_eval.proba(forest, ex) > 0.5).astype(jnp.int32)
+        return 1.0 - jnp.mean((pred == ey).astype(jnp.float32))
+
+    def _fit(codes, y, weights, edges, key):
+        f, th, v = trees_train.fit_forest_device(
+            codes, y, weights, edges, key,
+            n_trees=n_trees, max_depth=max_depth, n_bins=_LAL_BINS,
+        )
+        return trees_train.heap_gemm_forest(f, th, v, max_depth)
+
+    def one(x, y, ex, ey, mask, cand, key):
+        binned = trees_train.make_bins(x, _LAL_BINS)
+        k_base, k_cand = jax.random.split(key)
+        forest = _fit(binned.codes, y, mask.astype(jnp.float32), binned.edges, k_base)
+        err0 = _err(forest, ex, ey)
+        # The 5 features via the SAME device kernel the LAL strategy scores
+        # with at query time — no train/inference feature skew by
+        # construction (the sklearn twin this replaces re-derived them).
+        state = state_lib.PoolState(
+            x=x, oracle_y=y, labeled_mask=mask, key=key,
+            round=jnp.asarray(0, jnp.int32),
+        )
+        feats = lal_features(forest, state)[cand]  # [C, 5]
+
+        def refit(c, kc):
+            m2 = mask.at[c].set(True)
+            forest2 = _fit(binned.codes, y, m2.astype(jnp.float32), binned.edges, kc)
+            return err0 - _err(forest2, ex, ey)
+
+        targets = jax.vmap(refit)(cand, jax.random.split(k_cand, cand.shape[0]))
+        return feats, targets
+
+    return jax.vmap(one)(xs, ys, exs, eys, masks, cands, keys)
 
 
 def generate_lal_dataset(
@@ -76,10 +109,23 @@ def generate_lal_dataset(
     pool_size: int = 200,
     n_trees: int = 10,
     max_depth: int = 6,
+    batch_experiments: int = 16,
 ) -> Tuple[np.ndarray, np.ndarray]:
-    """Monte-Carlo synthesis of (features [m, 5], error-reduction targets [m])."""
+    """Monte-Carlo synthesis of (features [m, 5], error-reduction targets [m]).
+
+    The simulation procedure is the reference's (random unbalanced Gaussians,
+    random labeled subset seeded with one point per class, candidate-by-
+    candidate refit deltas), but the experiments execute BATCHED on device:
+    host numpy draws the per-experiment data/subsets/candidates, then one
+    jitted vmapped program (:func:`_lal_mc_batch`) fits, features, refits and
+    error-evals ``batch_experiments`` experiments x ``C`` candidates at a
+    time — replacing the per-process shard loop (the old shell for-loop
+    recipe) with a single invocation whose base learner is the SAME device
+    histogram trainer the AL loop uses.
+    """
     rng = np.random.default_rng(seed)
-    feats, targets = [], []
+    xs, ys, exs, eys, masks, cands, cand_valid = [], [], [], [], [], [], []
+    C = candidates_per_experiment
     for e in range(n_experiments):
         key = jax.random.key(seed * 100003 + e)
         tx, ty, ex, ey = make_gaussian_unbalanced(key, pool_size, dim=2)
@@ -96,26 +142,59 @@ def generate_lal_dataset(
         unlab_idx = np.setdiff1d(np.arange(pool_size), lab_idx)
         if len(unlab_idx) == 0:
             continue
-
-        model = RandomForestClassifier(
-            n_estimators=n_trees, max_depth=max_depth, random_state=int(rng.integers(1 << 30))
+        mask = np.zeros(pool_size, dtype=bool)
+        mask[lab_idx] = True
+        # Tiny pools may hold fewer than C unlabeled points: pad the
+        # candidate vector to the static width (repeating the first pick)
+        # and mask the padding out of the returned rows below — same
+        # min(C, available) yield as the per-experiment host loop had.
+        take = min(C, len(unlab_idx))
+        chosen = rng.choice(unlab_idx, size=take, replace=False)
+        xs.append(tx)
+        ys.append(ty)
+        exs.append(ex)
+        eys.append(ey)
+        masks.append(mask)
+        cands.append(np.concatenate([chosen, np.full(C - take, chosen[0])]))
+        cand_valid.append(np.arange(C) < take)
+    if not xs:
+        raise ValueError(
+            "every simulated experiment degenerated (single-class pool or no "
+            "unlabeled candidates)"
         )
-        model.fit(tx[lab_idx], ty[lab_idx])
-        err0 = 1.0 - model.score(ex, ey)
 
-        p_pool = _tree_votes(model, tx[unlab_idx]).mean(axis=0)
-        f6 = float(np.sqrt(p_pool * (1 - p_pool)).mean())
-        for c in rng.choice(unlab_idx, size=min(candidates_per_experiment, len(unlab_idx)), replace=False):
-            fv = _lal_point_features(model, tx[c], ty[lab_idx], tx[unlab_idx], f6=f6)
-            aug = np.concatenate([lab_idx, [c]])
-            m2 = RandomForestClassifier(
-                n_estimators=n_trees, max_depth=max_depth, random_state=int(rng.integers(1 << 30))
-            )
-            m2.fit(tx[aug], ty[aug])
-            err1 = 1.0 - m2.score(ex, ey)
-            feats.append(fv)
-            targets.append(err0 - err1)
-    return np.stack(feats), np.asarray(targets, dtype=np.float32)
+    # Every batch is padded to exactly ``batch_experiments`` wide (repeating
+    # experiment 0; padded rows are sliced off below) so the jitted program
+    # compiles ONCE per (batch width, pool size) — a 4-experiment smoke run
+    # and a 720-experiment production run share the executable shape, and the
+    # compile is the dominant CPU cost at smoke scale.
+    n_real = len(xs)
+    B = batch_experiments
+    order = list(range(n_real)) + [0] * ((-n_real) % B)
+    feats_out, targets_out = [], []
+    master = jax.random.key(seed ^ 0x1A1)
+    for lo in range(0, len(order), B):
+        sel = order[lo:lo + B]
+        keys = jax.vmap(lambda i: jax.random.fold_in(master, i))(
+            jnp.arange(lo, lo + B)
+        )
+        feats, targets = _lal_mc_batch(
+            jnp.asarray(np.stack([xs[i] for i in sel])),
+            jnp.asarray(np.stack([ys[i] for i in sel]), dtype=jnp.int32),
+            jnp.asarray(np.stack([exs[i] for i in sel])),
+            jnp.asarray(np.stack([eys[i] for i in sel]), dtype=jnp.int32),
+            jnp.asarray(np.stack([masks[i] for i in sel])),
+            jnp.asarray(np.stack([cands[i] for i in sel]), dtype=jnp.int32),
+            keys,
+            n_trees=n_trees,
+            max_depth=max_depth,
+        )
+        feats_out.append(np.asarray(feats))
+        targets_out.append(np.asarray(targets))
+    valid = np.stack(cand_valid)  # [n_real, C]
+    feats = np.concatenate(feats_out)[:n_real][valid]
+    targets = np.concatenate(targets_out)[:n_real][valid]
+    return feats.astype(np.float32), targets.astype(np.float32)
 
 
 def train_lal_regressor(
@@ -187,18 +266,20 @@ def load_or_train_lal_regressor(options: Mapping) -> PackedForest:
 
 
 def _main(argv=None) -> int:
-    """Generate a reference-format LAL training dataset shard.
+    """Generate a reference-format LAL training dataset.
 
     The reference's ``lal_randomtree_simulatedunbalanced_big.txt`` was
-    pre-synthesized offline at thousands of rows; this is its generator
-    (one shard per process — experiments are independent, so reference-scale
-    datasets are produced by running several seeds in parallel and
-    concatenating, e.g.::
+    pre-synthesized offline at thousands of rows; this is its generator.
+    Experiments run BATCHED on device (:func:`_lal_mc_batch` — the batched-
+    sweep discipline, one vmapped fit/refit/error program per fixed-width batch of
+    experiments), so a reference-scale dataset is ONE invocation::
 
-        for s in 0 1 2 3 4 5 6 7; do
-          python -m distributed_active_learning_tpu.models.lal_training \
-              --seed $s --experiments 90 --out /tmp/lal_shard_$s.txt &
-        done; wait; cat /tmp/lal_shard_*.txt > lal_simulatedunbalanced_big.txt
+        python -m distributed_active_learning_tpu.models.lal_training \
+            --seed 0 --experiments 720 --out lal_simulatedunbalanced_big.txt
+
+    (replacing the old per-process shard recipe — a shell for-loop over
+    seeds with a concatenation step — that existed only because the host
+    sklearn generator ran experiments serially).
 
     Output rows: 5 whitespace-separated features then the error-reduction
     target (the format ``lal_data_path`` loads).

@@ -79,6 +79,16 @@ def build_parser() -> argparse.ArgumentParser:
         "launch -> block -> touchdown order",
     )
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument(
+        "--sweep-seeds", type=int, default=1, metavar="N",
+        help="run N seeds (--seed .. --seed+N-1) as ONE batched launch "
+        "stream: the fused chunk program vmapped over a leading experiment "
+        "axis sharing the pool (runtime/sweep.py). Per-seed results are "
+        "bit-identical to N serial runs; stdout prints each seed's log under "
+        "a '# sweep seed' header, --out writes per-seed files "
+        "(out_s<seed>.txt). Needs --fit device for the batched path (host "
+        "fit falls back to N serial runs); forest loop only",
+    )
     # Observability (runtime/telemetry.py): structured JSONL metrics stream
     # and jax.profiler trace capture.
     ap.add_argument(
@@ -229,11 +239,25 @@ def main(argv=None) -> int:
         ap.error(
             "checkpointing needs both --checkpoint-dir and --checkpoint-every"
         )
+    if args.stream_rounds and args.sweep_seeds > 1:
+        # The batched sweep chunk carries no in-scan stream callback (E
+        # unordered per-experiment streams under vmap); refuse rather than
+        # silently drop the user's requested live events.
+        ap.error(
+            "--stream-rounds is not supported with --sweep-seeds > 1; "
+            "per-round events still arrive at every chunk touchdown via "
+            "--metrics-out"
+        )
     # The neural (deep-AL) loop runs only when asked for explicitly: via
     # --neural or a namespaced "deep.*" strategy name. Names living in both
     # registries (e.g. "entropy") default to the classic forest path, which is
     # the reference-parity target (density_weighting.py:148).
     if args.neural or args.strategy.startswith("deep."):
+        if args.sweep_seeds > 1:
+            ap.error(
+                "--sweep-seeds batches the forest loop's chunk program; the "
+                "neural path is not sweepable yet — loop over --seed instead"
+            )
         if args.mesh_model != 1:
             ap.error(
                 "the neural path shards pool rows only (--mesh-data); "
@@ -293,6 +317,7 @@ def main(argv=None) -> int:
         label_budget=args.budget,
         rounds_per_launch=args.rounds_per_launch,
         pipeline_depth=args.pipeline_depth,
+        sweep_seeds=args.sweep_seeds,
         stream_round_events=args.stream_rounds,
         seed=args.seed,
         results_path=None,  # _emit handles --out for both loop kinds
@@ -302,11 +327,20 @@ def main(argv=None) -> int:
     writer = _make_writer(args)
     try:
         with _profile(args):
-            result = run_experiment(cfg, debugger=dbg, metrics=writer)
+            if args.sweep_seeds > 1:
+                from distributed_active_learning_tpu.runtime.sweep import run_sweep
+
+                seeds = list(range(args.seed, args.seed + args.sweep_seeds))
+                results = run_sweep(cfg, seeds, debugger=dbg, metrics=writer)
+            else:
+                result = run_experiment(cfg, debugger=dbg, metrics=writer)
     finally:
         if writer is not None:
             writer.close()
-    _emit(args, result, dbg)
+    if args.sweep_seeds > 1:
+        _emit_sweep(args, results, seeds, dbg)
+    else:
+        _emit(args, result, dbg)
     return 0
 
 
@@ -426,6 +460,44 @@ def _run_neural(args, dbg, metrics=None):
         cfg, learner, bundle.train_x, bundle.train_y, bundle.test_x, bundle.test_y,
         debugger=dbg, data_ident=dataclasses.asdict(data_cfg), metrics=metrics,
     )
+
+
+def _emit_sweep(args, results, seeds, dbg):
+    """Per-seed emission for a batched sweep: stdout logs under '# sweep
+    seed' headers, --out as per-seed files, --plot as the mean +/- sd band
+    over the sweep (the paper's learning-curve aggregation)."""
+    import dataclasses as dc
+
+    from distributed_active_learning_tpu.runtime.sweep import _sweep_result_path
+
+    for seed, result in zip(seeds, results):
+        if args.json:
+            for r in result.records:
+                sys.stdout.write(
+                    json.dumps({"seed": seed, **dc.asdict(r)}) + "\n"
+                )
+        else:
+            sys.stdout.write(f"# sweep seed {seed}\n")
+            sys.stdout.write(result.to_reference_log())
+        if args.out:
+            result.save(_sweep_result_path(args.out, seed), fmt="reference")
+    if args.plot:
+        from distributed_active_learning_tpu.runtime.results import plot_seed_band
+
+        plot_seed_band(
+            results, args.plot,
+            title=f"{args.dataset} / {args.strategy} ({len(seeds)} seeds)",
+        )
+    if not args.quiet and results and results[0].final_accuracy is not None:
+        import numpy as np
+
+        finals = [r.final_accuracy for r in results if r.final_accuracy is not None]
+        print(
+            f"# sweep final: {len(seeds)} seeds, accuracy "
+            f"{np.mean(finals) * 100:.2f}% +/- {np.std(finals) * 100:.2f}%, "
+            f"total {dbg.total_time():.1f}s",
+            file=sys.stderr,
+        )
 
 
 def _emit(args, result, dbg):

@@ -307,6 +307,132 @@ def restore_latest(
         return _restore_base(z, step, state, result, fingerprint, kernel)
 
 
+_SWEEP_STEP_RE = re.compile(r"^sweepstate_(\d+)\.npz$")
+
+
+def sweep_fingerprint(cfg, seeds, windows) -> str:
+    """Identity hash of a batched sweep (runtime/sweep.py): the base
+    experiment identity plus the seed and window vectors — a sweep checkpoint
+    must only resume the SAME batch (same seeds in the same order, same
+    per-experiment windows), since the file stores all E experiments' state
+    positionally."""
+    ident = _forest_ident(cfg, with_mesh=False)
+    ident["sweep"] = {
+        "seeds": [int(s) for s in seeds],
+        "windows": [int(w) for w in windows],
+    }
+    return fingerprint_from_ident(ident)
+
+
+def save_sweep(
+    ckpt_dir: str,
+    masks,
+    key_data,
+    rounds,
+    results,
+    n_valid: int,
+    fingerprint: Optional[str] = None,
+) -> Optional[str]:
+    """Write one checkpoint covering all E experiments of a batched sweep.
+
+    ``masks [E, n]`` / ``key_data`` / ``rounds [E]`` are the sweep carry's
+    donation-safe snapshot (``runtime.loop.ckpt_snapshot`` over the batched
+    state); per-experiment records serialize as a list of record lists. The
+    step number is the MAX round across experiments (the furthest-ahead
+    experiment — finished experiments' rounds freeze, so once every
+    experiment has stopped, later saves overwrite that same step file).
+    Primary-process-only under multi-host, like :func:`save`.
+    """
+    from distributed_active_learning_tpu.parallel.multihost import host_np
+
+    masks_np = host_np(masks)[:, :n_valid]  # collective: all ranks
+    payload = {
+        "labeled_mask": masks_np,
+        "key": np.asarray(key_data),
+        "round": np.asarray(rounds, dtype=np.int32),
+        "records_json": np.frombuffer(
+            json.dumps(
+                [[dataclasses.asdict(r) for r in res.records] for res in results]
+            ).encode(),
+            dtype=np.uint8,
+        ),
+    }
+    if fingerprint is not None:
+        payload["config_fingerprint"] = np.frombuffer(
+            fingerprint.encode(), dtype=np.uint8
+        )
+    if jax.process_index() != 0:
+        return None
+    os.makedirs(ckpt_dir, exist_ok=True)
+    from distributed_active_learning_tpu.utils.io import atomic_savez
+
+    step = int(np.asarray(rounds).max())
+    return atomic_savez(os.path.join(ckpt_dir, f"sweepstate_{step}.npz"), **payload)
+
+
+def latest_sweep_step(ckpt_dir: str) -> Optional[int]:
+    if not os.path.isdir(ckpt_dir):
+        return None
+    steps = [
+        int(m.group(1))
+        for fn in os.listdir(ckpt_dir)
+        if (m := _SWEEP_STEP_RE.match(fn))
+    ]
+    return max(steps) if steps else None
+
+
+def restore_latest_sweep(
+    ckpt_dir: str,
+    n_valid: int,
+    n_experiments: int,
+    fingerprint: Optional[str] = None,
+):
+    """Load the newest sweep checkpoint; ``None`` if none exists.
+
+    Returns ``(masks [E, n_valid], key_data, rounds [E], results)`` as host
+    arrays + one :class:`ExperimentResult` per experiment. A fingerprint or
+    shape mismatch raises — resuming a different sweep's state positionally
+    would silently cross-wire every experiment.
+    """
+    step = latest_sweep_step(ckpt_dir)
+    if step is None:
+        return None
+    with np.load(os.path.join(ckpt_dir, f"sweepstate_{step}.npz")) as z:
+        stored_fp = (
+            bytes(z["config_fingerprint"]).decode()
+            if "config_fingerprint" in z.files
+            else None
+        )
+        if fingerprint is not None and stored_fp is not None and stored_fp != fingerprint:
+            raise ValueError(
+                f"sweep checkpoint fingerprint {stored_fp} != current sweep "
+                f"{fingerprint}: refusing to resume a different sweep's state"
+            )
+        masks = z["labeled_mask"]
+        key_data = z["key"]
+        rounds = z["round"]
+        records = json.loads(bytes(z["records_json"]).decode())
+    if masks.shape[0] != n_experiments:
+        raise ValueError(
+            f"sweep checkpoint holds {masks.shape[0]} experiments, the "
+            f"current sweep has {n_experiments}"
+        )
+    if masks.shape[1] != n_valid:
+        raise ValueError(
+            f"sweep checkpoint pool size ({masks.shape[1]},) != experiment "
+            f"pool ({n_valid},)"
+        )
+    known = {f.name for f in dataclasses.fields(RoundRecord)}
+    results = [
+        ExperimentResult(
+            records=[RoundRecord(**{k: v for k, v in r.items() if k in known})
+                     for r in recs]
+        )
+        for recs in records
+    ]
+    return masks, key_data, rounds, results
+
+
 def save_neural(
     ckpt_dir: str,
     state: PoolState,

@@ -38,6 +38,63 @@ from distributed_active_learning_tpu.runtime.results import ExperimentResult, Ro
 from distributed_active_learning_tpu.strategies import Strategy, StrategyAux, get_strategy
 
 
+def _round_core(
+    strategy: Strategy,
+    window_size: int,
+    with_metrics: bool,
+    n_classes: int,
+    forest: forest_eval.Forest,
+    state: state_lib.PoolState,
+    aux: StrategyAux,
+    window=None,
+):
+    """The AL round body shared by the plain and padded round functions.
+
+    ``window`` (a traced scalar <= ``window_size``, or None) restricts the
+    reveal to the first ``window`` picks: the batched-sweep driver
+    (runtime/sweep.py) pads every experiment to the sweep's widest window so
+    the vmapped top-k keeps one static k. ``lax.top_k`` returns picks in
+    selection order, so the first ``w`` of a top-``window_size`` selection ARE
+    the top-``w`` selection — truncation never changes which points a
+    narrower experiment reveals. Masked-out picks are neutralized exactly like
+    ops/topk.py's short-window sentinels (values to +/-inf, indices onto an
+    already-excluded pick), so the metrics' finite-pick filter and the
+    margin's candidate set both match a serial run at that window bit-for-bit.
+    """
+    key, k_score = jax.random.split(state.key)
+    state = state.replace(key=key)
+    with jax.named_scope("al/score"):
+        scores = strategy.score(forest, state, k_score, aux)
+    unlabeled = ~state.labeled_mask
+    with jax.named_scope("al/select"):
+        if strategy.higher_is_better:
+            vals, picked = select_top_k(scores, unlabeled, window_size)
+        else:
+            vals, picked = select_bottom_k(scores, unlabeled, window_size)
+    if window is None:
+        with jax.named_scope("al/reveal"):
+            new_state = state_lib.reveal(state, picked)
+    else:
+        from distributed_active_learning_tpu.ops.topk import NEG_INF, POS_INF
+
+        keep = jnp.arange(window_size) < window
+        sentinel = NEG_INF if strategy.higher_is_better else POS_INF
+        vals = jnp.where(keep, vals, sentinel)
+        picked = jnp.where(keep, picked, picked[0])
+        with jax.named_scope("al/reveal"):
+            new_state = state_lib.reveal_masked(state, picked, keep)
+    if not with_metrics:
+        return new_state, picked, scores
+    from distributed_active_learning_tpu.runtime import telemetry
+
+    rm = telemetry.compute_round_metrics(
+        forest, state, picked, vals, scores,
+        higher_is_better=strategy.higher_is_better,
+        n_classes=n_classes,
+    )
+    return new_state, picked, scores, rm
+
+
 def make_round_fn(
     strategy: Strategy,
     window_size: int,
@@ -59,28 +116,40 @@ def make_round_fn(
     def round_fn(
         forest: forest_eval.Forest, state: state_lib.PoolState, aux: StrategyAux
     ):
-        key, k_score = jax.random.split(state.key)
-        state = state.replace(key=key)
-        with jax.named_scope("al/score"):
-            scores = strategy.score(forest, state, k_score, aux)
-        unlabeled = ~state.labeled_mask
-        with jax.named_scope("al/select"):
-            if strategy.higher_is_better:
-                vals, picked = select_top_k(scores, unlabeled, window_size)
-            else:
-                vals, picked = select_bottom_k(scores, unlabeled, window_size)
-        with jax.named_scope("al/reveal"):
-            new_state = state_lib.reveal(state, picked)
-        if not with_metrics:
-            return new_state, picked, scores
-        from distributed_active_learning_tpu.runtime import telemetry
-
-        rm = telemetry.compute_round_metrics(
-            forest, state, picked, vals, scores,
-            higher_is_better=strategy.higher_is_better,
-            n_classes=n_classes,
+        return _round_core(
+            strategy, window_size, with_metrics, n_classes, forest, state, aux
         )
-        return new_state, picked, scores, rm
+
+    return round_fn
+
+
+def make_padded_round_fn(
+    strategy: Strategy,
+    window_pad: int,
+    with_metrics: bool = False,
+    n_classes: int = 2,
+):
+    """:func:`make_round_fn` with a per-call reveal width.
+
+    Returns ``round_fn(forest, state, aux, window)`` where ``window`` is a
+    traced scalar <= ``window_pad``: selection runs at the static pad width,
+    the reveal (and every pick-derived metric) is masked to the first
+    ``window`` picks. The batched-sweep driver vmaps this over experiments so
+    one compiled program serves heterogeneous window sizes; with
+    ``window == window_pad`` it is bit-identical to :func:`make_round_fn`.
+    """
+
+    @jax.jit
+    def round_fn(
+        forest: forest_eval.Forest,
+        state: state_lib.PoolState,
+        aux: StrategyAux,
+        window: jnp.ndarray,
+    ):
+        return _round_core(
+            strategy, window_pad, with_metrics, n_classes, forest, state, aux,
+            window=window,
+        )
 
     return round_fn
 
@@ -313,6 +382,25 @@ def make_chunk_fn(
     return chunk_fn
 
 
+@jax.jit
+def ckpt_snapshot(mask: jnp.ndarray, key: jax.Array, rnd: jnp.ndarray):
+    """Fresh-buffer device copy of the carry fields a checkpoint needs.
+
+    The chunk program donates its carried state, and the pipelined driver
+    dispatches chunk N+1 (consuming chunk N's output buffers) BEFORE chunk
+    N's touchdown runs — so a checkpointing touchdown cannot read the carry
+    itself. This tiny launch, run right after each chunk returns and before
+    the next dispatch, copies just (mask, key-data, round) into buffers the
+    donation cannot touch: ``optimization_barrier`` defeats both jax's
+    pass-through-output shortcut (which would hand back the very arrays the
+    next launch deletes) and XLA CSE, and a no-donation executable's outputs
+    never alias its inputs. Checkpointed chunked runs therefore keep carry
+    donation (ROADMAP PR-4 follow-up; pinned by the no-donation-warning +
+    resume tests in tests/test_chunked_driver.py).
+    """
+    return jax.lax.optimization_barrier((mask, jax.random.key_data(key), rnd))
+
+
 def build_aux(cfg: ExperimentConfig, state: state_lib.PoolState) -> StrategyAux:
     """Assemble strategy aux inputs (LAL regressor, seed mask) from config."""
     lal_forest = None
@@ -520,11 +608,6 @@ def run_experiment(
             wrap_pallas=(mesh is not None and cfg.forest.kernel == "pallas"),
             with_metrics=want_metrics,
             n_classes=n_classes,
-            # Checkpointed runs keep the carry un-donated: the pipelined
-            # driver dispatches chunk N+1 (which would consume and delete
-            # chunk N's output buffers) BEFORE chunk N's touchdown saves
-            # that very state to disk.
-            donate=not ckpt_enabled,
             stream_cb=stream_cb,
         )
         # The chunk donates the carried state's buffers; at round 0
@@ -567,13 +650,29 @@ def run_experiment(
                     "or lower label_budget/max_rounds"
                 )
 
-        def dispatch(st, _idx):
-            return chunk_fn(codes, st, aux, fit_key, test_x, test_y, end_round)
+        # Donation-safe checkpointing: the carry stays donated even for
+        # checkpointed runs; each dispatch snapshots the post-chunk
+        # (mask, key, round) into fresh buffers before the NEXT dispatch can
+        # consume the carry (see ckpt_snapshot), and the touchdown persists
+        # the snapshot instead of the carry.
+        snapshots = pipeline_lib.CarrySnapshots(ckpt_snapshot)
+        state_template = state
+        key_impl = jax.random.key_impl(state.key)
 
-        def touchdown(_idx, _n_labeled_after, n_active, ys, out_state, wall):
+        def dispatch(st, idx):
+            out = chunk_fn(codes, st, aux, fit_key, test_x, test_y, end_round)
+            if ckpt_enabled:
+                new_state = out[0]
+                snapshots.take(
+                    idx, new_state.labeled_mask, new_state.key, new_state.round
+                )
+            return out
+
+        def touchdown(_idx, _n_labeled_after, n_active, ys, _out_state, wall):
             # The chunk's host touchdown: materialize the (already async-
             # copied) stacked ys, bulk-append records, log, maybe checkpoint.
             # Runs overlapped with the next chunk's execution when depth > 1.
+            snap = snapshots.pop(_idx)
             if n_active == 0:
                 return  # wholly-inactive (speculative tail) chunk: no-op
             rounds_y, labeled_y, acc_y, _picked_y, active_y = ys[:5]
@@ -628,14 +727,21 @@ def run_experiment(
                 # Chunk-boundary checkpointing: saved at the first touchdown
                 # after each checkpoint_every multiple (steps need not align
                 # with the multiple itself — runtime/checkpoint.py notes).
-                # out_state is this chunk's post-chunk carry, valid to read
-                # here because checkpointed runs build the chunk un-donated.
+                # The post-chunk carry was donated to the next launch; the
+                # dispatch-time snapshot holds the same (mask, key, round)
+                # in buffers donation cannot touch (see ckpt_snapshot).
                 from distributed_active_learning_tpu.runtime import (
                     checkpoint as ckpt_lib,
                 )
 
+                s_mask, s_kd, s_rnd = snap
+                ckpt_state = state_template.replace(
+                    labeled_mask=s_mask,
+                    key=jax.random.wrap_key_data(s_kd, impl=key_impl),
+                    round=s_rnd,
+                )
                 ckpt_lib.save(
-                    cfg.checkpoint_dir, out_state, result,
+                    cfg.checkpoint_dir, ckpt_state, result,
                     fingerprint=ckpt_fp, kernel=ckpt_kernel,
                 )
                 ctl.checkpoint_done()

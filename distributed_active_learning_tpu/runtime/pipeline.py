@@ -172,6 +172,36 @@ class ChunkDriveControl:
         self._ckpt_mark = self.round_idx
 
 
+class CarrySnapshots:
+    """Dispatch-time donation-safe carry snapshots, keyed by chunk index.
+
+    Checkpointed chunked drives keep their carry donated (the next launch
+    consumes chunk N's output buffers before chunk N's touchdown runs); the
+    checkpointable fields are instead copied into fresh buffers right at
+    dispatch — ``snap_fn`` is the jitted copy program
+    (``runtime.loop.ckpt_snapshot``) — and handed back at the matching
+    touchdown. One implementation here serves both the forest driver and the
+    batched sweep driver, like :class:`ChunkDriveControl` does for their stop
+    arithmetic: the take-at-dispatch / pop-at-touchdown pairing must not
+    drift between them.
+    """
+
+    def __init__(self, snap_fn):
+        self._snap = snap_fn
+        self._held: dict = {}
+
+    def take(self, index: int, *leaves) -> None:
+        snap = self._snap(*leaves)
+        start_host_copy(snap)  # lands host-side under the next chunk's run
+        self._held[index] = snap
+
+    def pop(self, index: int):
+        """The snapshot taken at ``index``'s dispatch (None if never taken).
+        Call from EVERY touchdown — also the ones that skip checkpointing —
+        so speculative/inactive chunks' snapshots are released."""
+        return self._held.pop(index, None)
+
+
 def start_host_copy(tree: Any) -> None:
     """Begin a non-blocking device->host copy of every array in ``tree``.
 

@@ -135,6 +135,59 @@ def parse_reference_log(text: str) -> ExperimentResult:
     return result
 
 
+def strategy_curves(results):
+    """Stack per-seed accuracy curves onto their shared labeled-count grid.
+
+    ``results``: one :class:`ExperimentResult` per seed (e.g. a batched
+    sweep's output, ``runtime.sweep.run_sweep``) over the same window/rounds.
+    Returns ``(grid, accs)`` where ``grid`` is the n_labeled axis and ``accs``
+    is a ``[seeds, rounds]`` array — the aggregation the paper's learning
+    curves (mean +/- sd bands, ``plot_mean_band``) are built from. Raises if
+    the seeds disagree on the grid (different windows/stops do not share an
+    axis; plot those per seed instead).
+    """
+    import numpy as np
+
+    if not results:
+        raise ValueError("strategy_curves needs at least one result")
+    grid = [r.n_labeled for r in results[0].records]
+    for res in results[1:]:
+        g = [r.n_labeled for r in res.records]
+        if g != grid:
+            raise ValueError(
+                f"seed curves disagree on the labeled-count grid ({g[:3]}... "
+                f"vs {grid[:3]}...): stack only same-window, same-stop runs"
+            )
+    accs = np.array([[r.accuracy for r in res.records] for res in results])
+    return grid, accs
+
+
+def plot_seed_band(results, path: str, title: str = "", label: str = "sweep") -> str:
+    """Mean +/- 1 sd accuracy band over a sweep's per-seed results — the
+    in-memory twin of :func:`plot_mean_band` (which reads log files)."""
+    import matplotlib
+
+    matplotlib.use("Agg")  # headless
+    import matplotlib.pyplot as plt
+
+    grid, accs = strategy_curves(results)
+    accs = accs * 100
+    mean, sd = accs.mean(axis=0), accs.std(axis=0)
+    fig, ax = plt.subplots(figsize=(7.5, 4.5))
+    (line,) = ax.plot(grid, mean, marker="o", ms=3, label=f"{label} (n={len(results)})")
+    ax.fill_between(grid, mean - sd, mean + sd, alpha=0.2, color=line.get_color())
+    ax.set_xlabel("labeled points")
+    ax.set_ylabel("test accuracy (%)")
+    ax.grid(True, alpha=0.3)
+    ax.legend()
+    if title:
+        ax.set_title(title)
+    fig.tight_layout()
+    fig.savefig(path, dpi=120)
+    plt.close(fig)
+    return path
+
+
 def plot_result(result: ExperimentResult, path: str, title: str = "") -> str:
     """Save the experiment's curves as a PNG — the reference's per-run
     matplotlib artifact (``classes/active_learner.py:369-384`` plots

@@ -179,3 +179,20 @@ def reveal(state: PoolState, picked_idx: jnp.ndarray) -> PoolState:
     """
     mask = state.labeled_mask.at[picked_idx].set(True)
     return state.replace(labeled_mask=mask, round=state.round + 1)
+
+
+def reveal_masked(
+    state: PoolState, picked_idx: jnp.ndarray, keep: jnp.ndarray
+) -> PoolState:
+    """:func:`reveal` restricted to the picks where ``keep`` is True.
+
+    The batched-sweep round (runtime/sweep.py) pads every experiment's
+    selection to the sweep's widest window so the vmapped top-k has one
+    static k; picks past an experiment's own window must then be no-ops.
+    ``.max(keep)`` writes True only for kept picks and leaves the mask
+    untouched elsewhere — with ``keep`` all-True this is bit-identical to
+    :func:`reveal` (True max x == True), so the homogeneous-window sweep
+    reproduces the serial reveal exactly.
+    """
+    mask = state.labeled_mask.at[picked_idx].max(keep)
+    return state.replace(labeled_mask=mask, round=state.round + 1)

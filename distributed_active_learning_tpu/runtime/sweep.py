@@ -1,0 +1,638 @@
+"""Batched experiment sweeps: one launch stream advances E experiments.
+
+The paper's deliverable is never a single AL run — it is a grid of runs
+(strategies x seeds x window sizes) averaged into learning curves, and the
+LAL regressor's MC training set is itself hundreds of tiny simulated AL
+experiments. PRs 2-4 made ONE experiment launch-efficient (scan fusion +
+pipelined dispatch), but a sweep still paid E full serial drives. This module
+closes that gap with the batched-simulation discipline (podracer-style
+batched actors / EvoJAX-style vmapped populations, PAPERS.md): ``jax.vmap``
+over a leading experiment axis of the existing chunk program.
+
+Design:
+
+- **One pool, E experiments.** The pool feature matrix (and its binned codes,
+  test set, LAL regressor) is SHARED across the batch — only the per-
+  experiment state (labeled mask, PRNG key, round counter: :class:`SweepState`)
+  carries a leading ``[E]`` axis. A seed sweep therefore costs E bitmasks of
+  extra memory, not E pools.
+
+- **The chunk program is the unit of batching.** :func:`make_sweep_chunk_fn`
+  vmaps the SAME round body the serial chunk driver runs (device fit —
+  Poisson(1) bootstrap weights are partitioning-stable — scoring, masked
+  top-k reveal, accuracy eval, RoundMetrics) inside the same ``lax.scan``:
+  one jitted launch advances all E experiments by K rounds. Per-seed results
+  are bit-identical to E serial runs (tests/test_sweep.py, CPU and the 4x2
+  mesh): vmap is a compilation strategy here, never a semantic one.
+
+- **Heterogeneous windows via padding + masked reveal.** Experiments may use
+  different window sizes: selection runs at the sweep's widest window (one
+  static top-k) and the reveal (plus every pick-derived metric) is masked to
+  each experiment's own width (``runtime.loop.make_padded_round_fn``,
+  ``state.reveal_masked``) — ``lax.top_k`` is sorted, so the first w of a
+  top-W selection are exactly the top-w selection.
+
+- **Stopping reduces to one scalar pair.** Experiments hit their budgets at
+  different rounds; finished experiments continue as the chunk's existing
+  masked no-ops (state frozen bit-for-bit). The batched
+  :class:`~runtime.pipeline.ChunkExtras` reduce over the batch — MIN labeled
+  count, MAX active rounds — so the sweep runs until ALL experiments are done
+  and routes through ``runtime.pipeline.run_pipelined`` UNCHANGED (pipelined
+  dispatch, speculative chunks, async ys fetch all compose with batching).
+
+- **Mesh composition.** Under a device mesh the batch axis is vmapped OUTSIDE
+  the data-sharded pool: pool rows stay sharded over ``data``, masks shard as
+  ``[E(replicated), data]``, and ``constrain_forest`` asserts each
+  experiment's fitted forest placement inside the vmapped scan exactly as the
+  serial chunk does (the pallas kernel's shard_map wrapper batches too).
+
+Touchdowns unstack the ``[K, E, ...]`` ys into E independent
+:class:`~runtime.results.ExperimentResult` s — the per-seed records feeding
+``results.strategy_curves``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from distributed_active_learning_tpu.config import ExperimentConfig
+from distributed_active_learning_tpu.runtime import state as state_lib
+from distributed_active_learning_tpu.runtime.results import ExperimentResult
+from distributed_active_learning_tpu.strategies import Strategy, StrategyAux, get_strategy
+
+
+@struct.dataclass
+class SweepState:
+    """The per-experiment slice of E concurrent AL experiments.
+
+    Exactly the fields of :class:`~runtime.state.PoolState` that differ
+    between experiments sharing one pool — the chunk carry, donated
+    launch-to-launch like the serial driver's state. Shared pool arrays
+    (features, oracle labels, binned codes, test set) ride as separate
+    un-batched arguments.
+    """
+
+    labeled_mask: jnp.ndarray  # [E, n] bool
+    key: jax.Array             # [E] typed PRNG keys
+    round: jnp.ndarray         # [E] int32
+
+    @property
+    def n_experiments(self) -> int:
+        return self.labeled_mask.shape[0]
+
+
+def _labeled_counts(mask: jnp.ndarray, n_valid_static: int) -> jnp.ndarray:
+    """Per-experiment real-row labeled counts for a ``[E, n]`` mask batch."""
+    if n_valid_static >= 0:
+        valid = jnp.arange(mask.shape[1]) < n_valid_static
+        mask = mask & valid[None, :]
+    return jnp.sum(mask.astype(jnp.int32), axis=1)
+
+
+def make_sweep_chunk_fn(
+    strategy: Strategy,
+    window_pad: int,
+    chunk_size: int,
+    fit_fn,
+    label_cap: int,
+    *,
+    n_valid_static: int = -1,
+    mesh=None,
+    wrap_pallas: bool = False,
+    with_metrics: bool = False,
+    n_classes: int = 2,
+    donate: bool = True,
+):
+    """Vmap the fused AL chunk over a leading experiment axis E.
+
+    The body is the serial chunk's round (``runtime.loop.make_chunk_fn``):
+    device fit keyed per experiment, padded-window round, accuracy eval,
+    masked no-op freeze past each experiment's own stop — vmapped per scan
+    step, so one ``lax.scan`` launch advances every experiment by
+    ``chunk_size`` rounds. ``window_pad`` is the static selection width (the
+    sweep's widest window); each experiment's own width rides in the traced
+    ``windows`` vector.
+
+    Returns ``sweep_chunk_fn(codes, x, oracle_y, sweep, seed_masks,
+    lal_forest, fit_keys, windows, test_x, test_y, end_rounds) ->
+    (new_sweep, extras, ys)`` where every y is stacked ``[chunk_size, E,
+    ...]`` and ``extras`` is the batch-reduced
+    :class:`~runtime.pipeline.ChunkExtras`: MIN post-chunk labeled count and
+    MAX active-round count over experiments — ``>= label_cap`` / ``<
+    chunk_size`` therefore mean ALL experiments are done, which is exactly the
+    stop contract ``ChunkDriveControl``/``run_pipelined`` already implement,
+    so the sweep drives through the pipelined dispatcher unchanged.
+
+    ``donate`` donates the carried :class:`SweepState` buffers (the ``[E, n]``
+    masks dominate); the driver copies ``seed_masks`` so the round-0 alias
+    with the donated masks cannot dangle, exactly like the serial driver.
+    """
+    from distributed_active_learning_tpu.runtime.loop import (
+        _accuracy,
+        make_padded_round_fn,
+    )
+
+    round_fn = make_padded_round_fn(
+        strategy, window_pad, with_metrics=with_metrics, n_classes=n_classes
+    )
+
+    @functools.partial(jax.jit, donate_argnums=(3,) if donate else ())
+    def sweep_chunk_fn(
+        codes: jnp.ndarray,
+        x: jnp.ndarray,
+        oracle_y: jnp.ndarray,
+        sweep: SweepState,
+        seed_masks: jnp.ndarray,
+        lal_forest,
+        fit_keys: jax.Array,
+        windows: jnp.ndarray,
+        test_x: jnp.ndarray,
+        test_y: jnp.ndarray,
+        end_rounds: jnp.ndarray,
+    ):
+        def body(carry: SweepState, _):
+            def one(mask, key, rnd, seed_mask, fit_key, window, end_round):
+                # Rebuild the experiment's PoolState view over the SHARED
+                # pool arrays — same pytree the serial round consumes.
+                state = state_lib.PoolState(
+                    x=x, oracle_y=oracle_y, labeled_mask=mask, key=key,
+                    round=rnd, n_valid_static=n_valid_static,
+                )
+                aux = StrategyAux(lal_forest=lal_forest, seed_mask=seed_mask)
+                n_labeled = state_lib.labeled_count(state)
+                active = (n_labeled < label_cap) & (rnd < end_round)
+                forest = fit_fn(
+                    codes, state, jax.random.fold_in(fit_key, rnd + 1)
+                )
+                if mesh is not None:
+                    from distributed_active_learning_tpu.parallel import (
+                        constrain_forest,
+                    )
+
+                    forest = constrain_forest(forest, mesh)
+                    if wrap_pallas:
+                        from distributed_active_learning_tpu.ops.trees_pallas import (
+                            attach_mesh,
+                        )
+
+                        forest = attach_mesh(forest, mesh)
+                if with_metrics:
+                    new_state, picked, _, rm = round_fn(forest, state, aux, window)
+                else:
+                    new_state, picked, _ = round_fn(forest, state, aux, window)
+                acc = _accuracy(forest, test_x, test_y)
+                out = state_lib.select_state(active, new_state, state)
+                ys = (rnd + 1, n_labeled, acc, picked, active)
+                if with_metrics:
+                    ys = ys + (rm,)
+                return (out.labeled_mask, out.key, out.round), ys
+
+            (m, k, r), ys = jax.vmap(one)(
+                carry.labeled_mask, carry.key, carry.round,
+                seed_masks, fit_keys, windows, end_rounds,
+            )
+            return SweepState(labeled_mask=m, key=k, round=r), ys
+
+        out_sweep, ys = jax.lax.scan(body, sweep, None, length=chunk_size)
+        from distributed_active_learning_tpu.runtime.pipeline import ChunkExtras
+
+        counts = _labeled_counts(out_sweep.labeled_mask, n_valid_static)
+        active_per_exp = jnp.sum(ys[4].astype(jnp.int32), axis=0)  # [E]
+        extras = ChunkExtras(
+            # min/max reductions so the scalar pair means "ALL experiments":
+            # min labeled >= cap and max active < K only once every
+            # experiment hit its own stop.
+            n_labeled_after=jnp.min(counts),
+            n_active=jnp.max(active_per_exp),
+        )
+        return out_sweep, extras, ys
+
+    return sweep_chunk_fn
+
+
+def _resolve_sweep_fit_budget(
+    cfg: ExperimentConfig, n_pool: int, n_labeled_max: int, window_pad: int
+) -> int:
+    """Static fit-window capacity covering the WIDEST experiment in the batch
+    (the twin of ``runtime.loop._resolve_fit_budget``; the fit program is
+    shared by every experiment, so its capacity must cover the max)."""
+    if cfg.forest.fit_budget is not None:
+        return min(cfg.forest.fit_budget, n_pool)
+    caps = [n_pool]
+    if cfg.label_budget is not None:
+        caps.append(cfg.label_budget + window_pad)
+    if cfg.max_rounds is not None:
+        caps.append(n_labeled_max + cfg.max_rounds * window_pad)
+    return min(caps)
+
+
+def _sweep_result_path(path: str, seed: int) -> str:
+    """Per-seed results file: ``curve.txt`` -> ``curve_s3.txt``."""
+    import os
+
+    stem, ext = os.path.splitext(path)
+    return f"{stem}_s{seed}{ext}"
+
+
+def run_sweep(
+    cfg: ExperimentConfig,
+    seeds: Sequence[int],
+    windows: Optional[Sequence[int]] = None,
+    bundle=None,
+    debugger=None,
+    metrics=None,
+) -> List[ExperimentResult]:
+    """Run E = len(seeds) AL experiments over one shared pool as a single
+    batched launch stream; returns one :class:`ExperimentResult` per seed.
+
+    Per-seed records are bit-identical to running
+    ``runtime.loop.run_experiment`` once per seed with the same config
+    (``dataclasses.replace(cfg, seed=s)`` — and, when ``windows`` vary, the
+    matching ``window_size``) PROVIDED the fit budget is pinned: the device
+    fit's bootstrap draws depend on the fit window's static size, and the
+    default budget derives from the window size, so heterogeneous-window
+    parity needs an explicit ``ForestConfig.fit_budget``.
+
+    Falls back to E serial ``run_experiment`` calls for configurations the
+    batched chunk cannot express (host fit, or a Debugger demanding per-phase
+    wall splits) — the sweep entry point always runs.
+
+    ``windows`` (optional, per experiment) enables the padded-window path;
+    default is ``cfg.strategy.window_size`` everywhere.
+    ``cfg.stream_round_events`` is not supported by the batched chunk (a
+    per-experiment ``jax.debug.callback`` stream under vmap would interleave
+    E unordered streams) — round events still arrive per touchdown.
+    Checkpointing writes
+    ONE ``sweepstate_<round>.npz`` covering all experiments (donation-safe:
+    the carry snapshot rides ``runtime.loop.ckpt_snapshot``), and a resumed
+    sweep continues each experiment from its own frozen round.
+    """
+    from distributed_active_learning_tpu.data.datasets import get_dataset
+    from distributed_active_learning_tpu.runtime import (
+        pipeline as pipeline_lib,
+        telemetry,
+    )
+    from distributed_active_learning_tpu.runtime.debugger import Debugger
+    from distributed_active_learning_tpu.runtime.loop import (
+        build_aux,
+        ckpt_snapshot,
+        make_device_fit,
+        run_experiment,
+    )
+
+    seeds = [int(s) for s in seeds]
+    E = len(seeds)
+    if E == 0:
+        raise ValueError("run_sweep needs at least one seed")
+    windows = (
+        [int(cfg.strategy.window_size)] * E
+        if windows is None
+        else [int(w) for w in windows]
+    )
+    if len(windows) != E:
+        raise ValueError(f"{len(windows)} windows for {E} seeds")
+    window_pad = max(windows)
+    dbg = debugger or Debugger(enabled=False)
+
+    def _serial_fallback():
+        import os
+
+        out = []
+        for s, w in zip(seeds, windows):
+            scfg = dataclasses.replace(
+                cfg,
+                seed=s,
+                strategy=dataclasses.replace(cfg.strategy, window_size=w),
+                results_path=(
+                    _sweep_result_path(cfg.results_path, s)
+                    if cfg.results_path else None
+                ),
+                # one checkpoint dir per seed: the seed is part of the
+                # checkpoint identity, so a shared dir would make seed B's
+                # restore trip over seed A's state and refuse to resume
+                checkpoint_dir=(
+                    os.path.join(cfg.checkpoint_dir, f"seed_{s}")
+                    if cfg.checkpoint_dir else None
+                ),
+            )
+            out.append(
+                run_experiment(scfg, bundle=bundle, debugger=debugger,
+                               metrics=metrics)
+            )
+        return out
+
+    # The batched chunk needs the whole round device-resident, like the
+    # serial chunked driver: host fit and per-phase debugging fall back to E
+    # serial runs rather than fail (the sweep entry point always works).
+    if cfg.forest.fit != "device" or getattr(dbg, "phase_detail", False):
+        return _serial_fallback()
+
+    if cfg.stream_round_events:
+        # The batched chunk carries no in-scan stream callback, and silently
+        # dropping the flag here while the serial fallback above honors it
+        # would make the same config stream or not depending on fit mode.
+        raise ValueError(
+            "stream_round_events is not supported by the batched sweep "
+            "chunk; per-round events still arrive at every touchdown via "
+            "the MetricsWriter, or run the seeds serially"
+        )
+
+    if bundle is None:
+        bundle = get_dataset(cfg.data)
+    want_metrics = metrics is not None or cfg.collect_metrics
+
+    test_x = jnp.asarray(bundle.test_x)
+    test_y = jnp.asarray(bundle.test_y)
+    host_x = np.ascontiguousarray(bundle.train_x, dtype=np.float32)
+    host_y = np.asarray(bundle.train_y, dtype=np.int32)
+    n_classes = max(int(host_y.max()) + 1, 2) if host_y.size else 2
+
+    # Per-seed start states over ONE shared pool: exactly run_experiment's
+    # init -> set_start_state sequence per seed, so masks/keys agree with the
+    # serial runs bit-for-bit — but the pool arrays are placed once and
+    # shared by reference (replace() keeps base.x/oracle_y), so E seeds cost
+    # E bitmasks of device memory, not E pools.
+    base = state_lib.init_pool_state(host_x, host_y, jax.random.key(seeds[0]))
+    states = [
+        state_lib.set_start_state(
+            base.replace(key=jax.random.key(s)), cfg.n_start, n_classes=n_classes
+        )
+        for s in seeds
+    ]
+
+    mesh = None
+    mesh_lib = None
+    if cfg.mesh.data * cfg.mesh.model > 1:
+        from distributed_active_learning_tpu.parallel import (
+            make_mesh,
+            mesh as mesh_lib,
+        )
+
+        if cfg.forest.n_trees % cfg.mesh.model:
+            raise ValueError(
+                f"n_trees={cfg.forest.n_trees} not divisible by mesh "
+                f"model axis {cfg.mesh.model}"
+            )
+        mesh = make_mesh(data=cfg.mesh.data, model=cfg.mesh.model)
+        # Pad the shared pool ONCE; the other experiments re-share the padded
+        # arrays and pad only their own masks (padding rows read labeled, the
+        # pad_for_sharding rule).
+        padded0 = state_lib.pad_for_sharding(states[0], cfg.mesh.data)
+        row_pad = padded0.n_pool - states[0].n_pool
+        states = [padded0] + [
+            padded0.replace(
+                labeled_mask=jnp.pad(
+                    st.labeled_mask, (0, row_pad), constant_values=True
+                ),
+                key=st.key,
+                round=st.round,
+            )
+            for st in states[1:]
+        ]
+        test_x = mesh_lib.global_put(test_x, mesh, mesh_lib.replicated_spec())
+        test_y = mesh_lib.global_put(test_y, mesh, mesh_lib.replicated_spec())
+
+    n_valid_static = states[0].n_valid_static
+    n_pool = states[0].n_valid
+    x = states[0].x
+    oracle_y = states[0].oracle_y
+    masks0 = jnp.stack([st.labeled_mask for st in states])
+    # The strategies' seed masks are the INITIAL start masks — captured here,
+    # before a checkpoint restore advances masks0, exactly like the serial
+    # driver builds aux from the pre-restore start state. (A copy, not a
+    # view: at round 0 of a fresh run the carried masks alias these, and the
+    # chunk donates its carry.)
+    seed_masks = jnp.array(masks0, copy=True)
+    keys0 = jnp.stack([st.key for st in states])
+    rounds0 = jnp.stack([st.round for st in states])
+
+    strategy = get_strategy(cfg.strategy)
+    # Shared strategy aux: one LAL regressor for the whole batch; the
+    # per-experiment seed masks ride batched (seed_masks above).
+    lal_forest = build_aux(cfg, states[0]).lal_forest
+
+    if metrics is not None:
+        from distributed_active_learning_tpu.config import asdict as cfg_asdict
+
+        metrics.meta(
+            config=cfg_asdict(cfg),
+            backend=jax.default_backend(),
+            n_devices=jax.device_count(),
+            process_count=jax.process_count(),
+            sweep_seeds=seeds,
+            sweep_windows=windows,
+        )
+
+    results = [ExperimentResult() for _ in range(E)]
+    start_rounds = [0] * E
+
+    ckpt_enabled = bool(cfg.checkpoint_dir and cfg.checkpoint_every)
+    ckpt_fp = None
+    key_impl = jax.random.key_impl(keys0)
+    if ckpt_enabled:
+        from distributed_active_learning_tpu.runtime import checkpoint as ckpt_lib
+
+        ckpt_fp = ckpt_lib.sweep_fingerprint(cfg, seeds, windows)
+        restored = ckpt_lib.restore_latest_sweep(
+            cfg.checkpoint_dir, n_valid=n_pool, n_experiments=E,
+            fingerprint=ckpt_fp,
+        )
+        if restored is not None:
+            r_masks, r_keys, r_rounds, results = restored
+            pad = masks0.shape[1] - r_masks.shape[1]
+            if pad:
+                # mesh padding rows read as labeled (pad_for_sharding rule)
+                r_masks = np.pad(r_masks, ((0, 0), (0, pad)), constant_values=True)
+            masks0 = jnp.asarray(r_masks)
+            keys0 = jax.random.wrap_key_data(jnp.asarray(r_keys), impl=key_impl)
+            rounds0 = jnp.asarray(r_rounds, dtype=jnp.int32)
+            start_rounds = [int(r) for r in np.asarray(r_rounds)]
+            dbg.debug(f"resumed sweep at rounds {start_rounds}")
+
+    # Device fit shared by every experiment: one binning of the shared pool,
+    # one fit program wide enough for the widest window.
+    from distributed_active_learning_tpu.ops import trees_train
+
+    binned = trees_train.make_bins(jnp.asarray(host_x), cfg.forest.max_bins)
+    codes = binned.codes
+    if states[0].n_pool > codes.shape[0]:
+        codes = jnp.pad(codes, ((0, states[0].n_pool - codes.shape[0]), (0, 0)))
+    counts0 = [int(c) for c in np.asarray(_labeled_counts(masks0, n_valid_static))]
+    fit_budget = _resolve_sweep_fit_budget(cfg, n_pool, max(counts0), window_pad)
+    device_fit = make_device_fit(cfg, binned.edges, fit_budget, n_classes)
+    fit_keys = jnp.stack([jax.random.key(s + 0x5EED) for s in seeds])
+
+    windows_dev = jnp.asarray(windows, dtype=jnp.int32)
+    label_cap = n_pool if cfg.label_budget is None else min(cfg.label_budget, n_pool)
+    end_rounds = jnp.asarray(
+        [
+            (sr + cfg.max_rounds) if cfg.max_rounds is not None
+            else int(np.iinfo(np.int32).max)
+            for sr in start_rounds
+        ],
+        dtype=jnp.int32,
+    )
+
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        x = mesh_lib.global_put(x, mesh, mesh_lib.pool_spec())
+        oracle_y = mesh_lib.global_put(oracle_y, mesh, mesh_lib.mask_spec())
+        codes = mesh_lib.global_put(codes, mesh, mesh_lib.pool_spec())
+        # batch axis OUTSIDE the data-sharded pool: E replicated, rows sharded
+        batch_mask_spec = P(None, mesh_lib.AXIS_DATA)
+        masks0 = jax.device_put(masks0, NamedSharding(mesh, batch_mask_spec))
+        seed_masks = jax.device_put(seed_masks, NamedSharding(mesh, batch_mask_spec))
+        rep = NamedSharding(mesh, P())
+        keys0 = mesh_lib.global_put(keys0, mesh, mesh_lib.replicated_spec())
+        fit_keys = mesh_lib.global_put(fit_keys, mesh, mesh_lib.replicated_spec())
+        rounds0 = jax.device_put(rounds0, rep)
+        windows_dev = jax.device_put(windows_dev, rep)
+        end_rounds = jax.device_put(end_rounds, rep)
+
+    K = max(int(cfg.rounds_per_launch or 1), 1)
+    depth = max(int(getattr(cfg, "pipeline_depth", 1) or 1), 1)
+    sweep_chunk = make_sweep_chunk_fn(
+        strategy, window_pad, K, device_fit, label_cap,
+        n_valid_static=n_valid_static,
+        mesh=mesh,
+        wrap_pallas=(mesh is not None and cfg.forest.kernel == "pallas"),
+        with_metrics=want_metrics,
+        n_classes=n_classes,
+    )
+    launches = telemetry.LaunchTracker(metrics, "sweep_chunk_scan", fn=sweep_chunk)
+
+    # Host stop/veto arithmetic: ChunkDriveControl over the batch-reduced
+    # scalars. The conservative lattice (MIN known count, MIN window) can only
+    # under-veto, and MAX active per chunk counts the laggard experiment —
+    # exactly the one max_rounds must bound.
+    ctl = pipeline_lib.ChunkDriveControl(
+        K, min(windows), label_cap, cfg.max_rounds,
+        min(counts0), max(start_rounds),
+    )
+
+    if not ctl.already_done:
+        # Whole-run fit-capacity guard, per experiment (the serial driver's
+        # lattice projection, run for each (count, window) pair in the batch).
+        worst = 0
+        for c0, w in zip(counts0, windows):
+            j_cap = -(-(label_cap - c0) // w) - 1
+            if cfg.max_rounds is not None:
+                j_cap = min(cfg.max_rounds - 1, j_cap)
+            worst = max(worst, c0 + max(j_cap, 0) * w)
+        if worst > fit_budget:
+            raise ValueError(
+                f"up to {worst} labeled rows would exceed the device fit "
+                f"window ({fit_budget}); raise ForestConfig.fit_budget or "
+                "lower label_budget/max_rounds"
+            )
+
+    sweep_state = SweepState(labeled_mask=masks0, key=keys0, round=rounds0)
+    snapshots = pipeline_lib.CarrySnapshots(ckpt_snapshot)
+
+    def dispatch(sw, idx):
+        out = sweep_chunk(
+            codes, x, oracle_y, sw, seed_masks, lal_forest, fit_keys,
+            windows_dev, test_x, test_y, end_rounds,
+        )
+        if ckpt_enabled:
+            new_sweep = out[0]
+            snapshots.take(
+                idx, new_sweep.labeled_mask, new_sweep.key, new_sweep.round
+            )
+        return out
+
+    def touchdown(idx, _n_labeled_after, n_active, ys, _out, wall):
+        snap = snapshots.pop(idx)
+        if n_active == 0:
+            return
+        rounds_y, labeled_y, acc_y, _picked_y, active_y = ys[:5]
+        active_np = np.asarray(active_y)  # [K, E]
+        rounds_np = np.asarray(rounds_y)
+        labeled_np = np.asarray(labeled_y)
+        acc_np = np.asarray(acc_y)
+        total_active = int(active_np.sum())
+        md = (
+            telemetry.stacked_sweep_metrics_to_dicts(ys[5], active_np)
+            if want_metrics
+            else None
+        )
+        last_round = ctl.round_idx
+        for e in range(E):
+            act = active_np[:, e]
+            if not act.any():
+                continue
+            r_e = rounds_np[act, e]
+            l_e = labeled_np[act, e]
+            a_e = acc_np[act, e]
+            results[e].extend_from_arrays(
+                r_e, l_e, n_pool - l_e, a_e,
+                # wall attributed per experiment-round: the launch advanced
+                # total_active rounds across the whole batch.
+                total_time=wall / total_active,
+                metrics=md[e] if md is not None else None,
+            )
+            last_round = max(last_round, int(r_e[-1]))
+            if metrics is not None:
+                for i in range(len(r_e)):
+                    metrics.round(
+                        exp=e,
+                        seed=seeds[e],
+                        round=int(r_e[i]),
+                        n_labeled=int(l_e[i]),
+                        accuracy=float(a_e[i]),
+                        **(md[e][i] if md is not None else {}),
+                    )
+            if cfg.log_every and dbg.enabled:
+                for r, nl, a in zip(r_e, l_e, a_e):
+                    if int(r) % cfg.log_every == 0:
+                        dbg.debug(
+                            f"[seed {seeds[e]}] Iteration {int(r)} -- "
+                            f"labeled={int(nl)} accu={float(a) * 100:.2f}"
+                        )
+        ctl.note_round(last_round)
+        if metrics is not None:
+            fetched = (
+                active_y.nbytes + rounds_y.nbytes + labeled_y.nbytes
+                + acc_y.nbytes
+            )
+            if want_metrics:
+                fetched += telemetry.metrics_nbytes(ys[5])
+            metrics.counter("host_transfer_bytes", int(fetched))
+            mem = telemetry.device_memory_gauges()
+            if mem:
+                metrics.gauges(mem, allgather=True)
+        if ckpt_enabled and ctl.checkpoint_due(cfg.checkpoint_every):
+            from distributed_active_learning_tpu.runtime import (
+                checkpoint as ckpt_lib,
+            )
+
+            s_masks, s_kd, s_rounds = snap
+            ckpt_lib.save_sweep(
+                cfg.checkpoint_dir, s_masks, s_kd, s_rounds, results,
+                n_valid=n_pool, fingerprint=ckpt_fp,
+            )
+            ctl.checkpoint_done()
+
+    if not ctl.already_done:
+        pipeline_lib.run_pipelined(
+            sweep_state,
+            dispatch=dispatch,
+            touchdown=touchdown,
+            continue_after=ctl.continue_after,
+            depth=depth,
+            on_launch=launches.record,
+            may_dispatch=ctl.may_dispatch,
+        )
+
+    if cfg.results_path:
+        for s, res in zip(seeds, results):
+            res.save(_sweep_result_path(cfg.results_path, s), fmt="reference")
+    return results

@@ -240,6 +240,24 @@ def stacked_metrics_to_dicts(
     ]
 
 
+def stacked_sweep_metrics_to_dicts(
+    rm_stacked: RoundMetrics, active: np.ndarray
+) -> List[List[Dict[str, Any]]]:
+    """Sweep-touchdown conversion: ``[K, E, ...]`` batched scan-ys metrics ->
+    one dict list per EXPERIMENT, each holding that experiment's active rounds
+    in order (the batched twin of :func:`stacked_metrics_to_dicts`; one
+    ``device_get`` of the whole stacked pytree, then host-side slicing)."""
+    host = jax.device_get(rm_stacked)
+    active = np.asarray(active)
+    return [
+        [
+            {name: _field_to_py(host, name, (i, e)) for name in _METRIC_FIELDS}
+            for i in np.flatnonzero(active[:, e])
+        ]
+        for e in range(active.shape[1])
+    ]
+
+
 def metrics_nbytes(rm_stacked: RoundMetrics) -> int:
     """Bytes the stacked metrics add to a chunk touchdown transfer.
 

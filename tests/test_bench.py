@@ -96,3 +96,57 @@ def test_bench_neural_tiny_pool_keeps_candidates(bench):
     r = bench.bench_neural(_args())
     assert r["cnn_round_seconds"] > 0
     assert r["transformer_batchbald_round_seconds"] > 0
+
+
+def test_trace_parser_folds_named_scopes(bench, tmp_path):
+    """device_seconds_by_phase: a chrome-trace capture's complete events fold
+    onto the jax.named_scope phase names (innermost scope wins, so nested
+    scopes never double-count), microseconds -> seconds."""
+    import gzip
+    import json
+    import os
+
+    run_dir = os.path.join(tmp_path, "plugins", "profile", "2026_01_01")
+    os.makedirs(run_dir)
+    events = [
+        # op events as TPU device lanes name them: name-stack prefixes
+        {"ph": "X", "name": "jit(chunk_fn)/al/score/fusion.3", "dur": 1500},
+        {"ph": "X", "name": "jit(chunk_fn)/al/score/reduce.1", "dur": 500},
+        # args-carried long name (some backends put the stack in args)
+        {"ph": "X", "name": "fusion.7", "dur": 2000,
+         "args": {"long_name": "jit(fit)/trees/fit_forest_device/dot.2"}},
+        # nested scopes: charged to the INNERMOST (trees/...), not al/fit
+        {"ph": "X", "name": "jit(f)/al/fit/trees/gather_fit_window/add.1",
+         "dur": 250},
+        # scope-aggregation lane spans (path ENDS at the scope) are skipped:
+        # their duration already covers the op rows above — counting both
+        # would double every phase on TPU captures carrying both lanes
+        {"ph": "X", "name": "al/score", "dur": 2000},
+        {"ph": "X", "name": "jit(chunk_fn)/al/score", "dur": 2000},
+        # non-phase noise and incomplete events are ignored
+        {"ph": "X", "name": "copy.1", "dur": 9999},
+        {"ph": "M", "name": "al/score"},
+    ]
+    with gzip.open(os.path.join(run_dir, "host.trace.json.gz"), "wt") as f:
+        json.dump({"traceEvents": events}, f)
+
+    phases = bench._trace_phases(str(tmp_path))
+    assert phases == {
+        "al/score": 0.002,
+        "trees/fit_forest_device": 0.002,
+        "trees/gather_fit_window": 0.00025,
+    }
+    # empty dirs parse to {} (profiling off / CPU captures without op lanes)
+    assert bench._trace_phases(str(tmp_path / "empty")) == {}
+
+
+@pytest.mark.slow  # two serial run_experiment compiles + one sweep compile
+def test_bench_sweep_contract(bench):
+    """Sweep mode: batched and serial experiments*rounds/s both present and
+    positive (the CI smoke job asserts the same contract on every PR)."""
+    r = bench.bench_sweep(_args(
+        sweep_experiments=2, sweep_pool=120, rounds_per_launch=2, window=10,
+    ))
+    assert r["sweep_experiments_rounds_per_second"] > 0
+    assert r["serial_experiments_rounds_per_second"] > 0
+    assert r["sweep_speedup"] > 0

@@ -120,18 +120,29 @@ def test_chunked_checkpoint_resume_bit_identical(tmp_path):
     """Chunk-boundary checkpoints (saved at the first touchdown at/after each
     checkpoint_every multiple) must resume into a curve bit-identical to an
     uninterrupted PER-ROUND run — crossing both the driver kind and the
-    interruption. fit_budget is pinned because the device fit's bootstrap
-    draws depend on the window's static size, and the budget otherwise
-    defaults from max_rounds (which legitimately differs across the runs)."""
+    interruption. Checkpointed runs now KEEP carry donation (the dispatch-time
+    ckpt_snapshot copies mask/key/round into buffers the next launch's
+    donation cannot touch — ROADMAP PR-4 follow-up), so the checkpointed run
+    must also emit no donation warnings. fit_budget is pinned because the
+    device fit's bootstrap draws depend on the window's static size, and the
+    budget otherwise defaults from max_rounds (which legitimately differs
+    across the runs)."""
     import os
+    import warnings
 
     ckpt = os.path.join(tmp_path, "ckpt")
     forest = ForestConfig(n_trees=10, max_depth=4, fit="device", fit_budget=256)
     full = run_experiment(_cfg(1, forest=forest, max_rounds=8, seed=4))
-    run_experiment(
-        _cfg(3, forest=forest, max_rounds=4, seed=4,
-             checkpoint_dir=ckpt, checkpoint_every=1)
-    )
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        run_experiment(
+            _cfg(3, forest=forest, max_rounds=4, seed=4,
+                 checkpoint_dir=ckpt, checkpoint_every=1)
+        )
+    donation_warnings = [
+        str(w.message) for w in caught if "donat" in str(w.message).lower()
+    ]
+    assert donation_warnings == []
     # K=3 over 4 rounds -> touchdowns (and saves) land at rounds 3 and 4.
     assert sorted(os.listdir(ckpt)) == ["alstate_3.npz", "alstate_4.npz"]
     resumed = run_experiment(

@@ -121,7 +121,11 @@ def test_lal_end_to_end_with_tiny_regressor(setup):
         train_lal_regressor,
     )
 
-    feats, targets = generate_lal_dataset(seed=0, n_experiments=4, candidates_per_experiment=3, pool_size=60)
+    # pool_size/candidates chosen to SHARE the batched MC program's compiled
+    # shape (16-wide batch, 8 candidates, 200-row pools) with the syntheses
+    # test_cli/test_forest already triggered — the generator's device batches
+    # are padded to a fixed width for exactly this reuse.
+    feats, targets = generate_lal_dataset(seed=0, n_experiments=4, candidates_per_experiment=8, pool_size=200)
     assert feats.shape[1] == 5 and len(targets) == len(feats)
     reg = train_lal_regressor(feats, targets, n_trees=10, max_depth=4)
     strat = get_strategy(StrategyConfig(name="lal", window_size=3))
