@@ -1257,6 +1257,40 @@ def _trace_phases(profile_dir: str) -> dict:
     return mod.device_seconds_by_phase(profile_dir)
 
 
+def _audit_gate() -> dict:
+    """``--audit``: run the static program auditor over the full registry
+    before any bench body executes. Error findings raise (the except path
+    still prints the one JSON line, carrying the audit error); the clean
+    verdict rides the final payload under ``audit``."""
+    import sys
+
+    import jax
+
+    from distributed_active_learning_tpu.analysis import (
+        build_registry,
+        default_lint_targets,
+        lint_paths,
+        run_audit,
+    )
+
+    placements = None if len(jax.devices()) >= 8 else ["cpu"]
+    report = run_audit(build_registry(placements=placements))
+    report.extend(lint_paths(default_lint_targets()))
+    if report.gate("error"):
+        print(report.render_table(), file=sys.stderr)
+        raise RuntimeError(
+            f"program audit failed before benching: {report.counts()} "
+            "(findings on stderr; reproduce with "
+            "`python -m distributed_active_learning_tpu.analysis`)"
+        )
+    return {
+        "programs_audited": len(report.programs),
+        "programs_skipped": len(report.skipped),
+        "counts": report.counts(),
+        "max_severity": report.max_severity,
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument(
@@ -1313,6 +1347,14 @@ def main():
         "CPU smoke runs)",
     )
     ap.add_argument(
+        "--audit", action="store_true",
+        help="audit-before-bench: statically trace the registered fused "
+        "programs (analysis/ jaxpr auditor + recompile-hazard lint) before "
+        "any timing runs; error-severity findings abort the bench (JSON "
+        "still prints, with the audit verdict) so a regression like r04 is "
+        "named at PR time instead of surfacing as a mystery MFU drop",
+    )
+    ap.add_argument(
         "--deadline", type=float, default=None,
         help="wall-seconds budget for --mode all: once exceeded, remaining "
         "modes are skipped (recorded under modes_skipped) and the JSON for "
@@ -1346,8 +1388,11 @@ def main():
         signal.signal(sig, _interrupted)
 
     cpu_sizes = False
+    audit_summary = None
     try:
         cpu_sizes = _resolve_sizes(args)
+        if args.audit:
+            audit_summary = _audit_gate()
         if args.profile_dir:
             # Whole-suite jax.profiler capture; afterwards the trace's
             # op-level timeline folds back onto the named_scope phase names
@@ -1374,6 +1419,8 @@ def main():
         rc = 0 if isinstance(e, BenchInterrupted) else 1
     if cpu_sizes:
         payload["cpu_smoke_sizes"] = True
+    if audit_summary is not None:
+        payload["audit"] = audit_summary
     print(json.dumps(payload))
     raise SystemExit(rc)
 

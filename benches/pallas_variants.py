@@ -121,7 +121,6 @@ def _kernel_transposed(
 ):
     bt, i_pad = thr_ref.shape
     l_pad = pathT_ref.shape[1]
-    bn = xT_ref.shape[1]
     if main_bf16:
         # Ancestor counts are small ints — exact in bf16; a bf16 main GEMM
         # spills its [i_pad, BN] output at 2 bytes/elem instead of 4.

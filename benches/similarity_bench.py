@@ -50,11 +50,11 @@ def main():
             fn = jax.jit(lambda a: similarity_mass(a, mask))
         else:
             fn = jax.jit(pairwise_cosine)
-        out = jax.block_until_ready(fn(x))
+        jax.block_until_ready(fn(x))  # warmup/compile
         times = []
         for _ in range(args.iters):
             t0 = time.perf_counter()
-            out = jax.block_until_ready(fn(x))
+            jax.block_until_ready(fn(x))
             times.append(time.perf_counter() - t0)
         best = min(times)
         entries_per_sec = (n * n if not args.mass_only else n) / best

@@ -108,7 +108,7 @@ def _paired_deltas(prefix):
     if not d_rand:
         for seed, missing in incomplete:
             print(f"seed {seed} incomplete — missing {missing}")
-        print(f"no complete seed triples — run benches/run_lal_showcase.sh")
+        print("no complete seed triples — run benches/run_lal_showcase.sh")
         return
     print(f"| mean | {np.mean(d_rand):+.4f} | {np.mean(d_us):+.4f} |")
     for seed, missing in incomplete:

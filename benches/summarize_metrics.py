@@ -26,6 +26,7 @@ import json
 import os
 import re
 import sys
+from collections import Counter
 from typing import Dict, List
 
 
@@ -79,10 +80,15 @@ def device_seconds_by_phase(profile_dir: str) -> Dict[str, float]:
         try:
             trace = _load_trace(path)
         except (OSError, ValueError):
-            continue
-        for ev in trace.get("traceEvents", []):
-            if ev.get("ph") != "X" or "dur" not in ev:
+            continue  # truncated gz / malformed JSON / empty file: skip
+        events = trace.get("traceEvents", []) if isinstance(trace, dict) else []
+        if not isinstance(events, list):
+            continue  # non-chrome-trace JSON that happens to match the glob
+        for ev in events:
+            if not isinstance(ev, dict) or ev.get("ph") != "X":
                 continue
+            if not isinstance(ev.get("dur"), (int, float)):
+                continue  # absent or malformed duration
             hay = ev.get("name", "")
             args = ev.get("args")
             if isinstance(args, dict):
@@ -180,10 +186,20 @@ def summarize(events: List[dict]) -> str:
             + _table(["phase", "calls", "total s", "mean s"], phase_rows)
         )
 
-    if launches:
+    vetoes = [e for e in events if e.get("kind") == "launch_veto"]
+    if launches or vetoes:
         rows = []
-        for program in sorted({e["program"] for e in launches}):
+        for program in sorted(
+            {e["program"] for e in launches} | {e["program"] for e in vetoes}
+        ):
             evs = [e for e in launches if e["program"] == program]
+            v_evs = [e for e in vetoes if e["program"] == program]
+            by_reason = Counter(str(e.get("reason", "?")) for e in v_evs)
+            veto_cell = (
+                "-" if not v_evs else ",".join(
+                    f"{reason}={n}" for reason, n in sorted(by_reason.items())
+                )
+            )
             first = next((e for e in evs if e.get("first_call")), None)
             steady = [e["seconds"] for e in evs if not e.get("first_call")]
             # Pipelined-driver overlap accounting (runtime/pipeline.py): how
@@ -201,13 +217,14 @@ def summarize(events: List[dict]) -> str:
                     sum(1 for e in evs if e.get("recompiled")),
                     f"{sum(td):.4f}" if td else "-",
                     hidden,
+                    veto_cell,
                 ]
             )
         out.append(
             "\n== launches ==\n"
             + _table(
                 ["program", "calls", "first (compile) s", "steady mean s",
-                 "recompiles", "touchdown s", "hidden"],
+                 "recompiles", "touchdown s", "hidden", "vetoed"],
                 rows,
             )
         )

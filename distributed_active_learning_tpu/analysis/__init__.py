@@ -1,0 +1,43 @@
+"""Static program auditing: jaxpr invariant checks + recompile-hazard lint.
+
+The deep-stack PRs (scan fusion, donation, padded reveals, pipelined
+dispatch, vmapped sweeps) built a fast path whose performance rests on
+invariants nothing verified — a stray host callback, a dropped donation, or
+a weak-type leak costs exactly the perf the bench trajectory tracks (the r04
+MFU regression was found only by re-benching). This package verifies them
+statically, at PR time:
+
+- :mod:`analysis.programs` rebuilds every fused program the drivers launch
+  (strategy x {chunk, sweep, neural_chunk} x {cpu, mesh4x2}) over abstract
+  inputs;
+- :mod:`analysis.auditor` traces each one and applies the jaxpr rule
+  registry (:mod:`analysis.rules`);
+- :mod:`analysis.lint` AST-scans ``runtime/`` and ``strategies/`` for
+  host-sync and retrace hazards the trace can't see;
+- :mod:`analysis.report` renders both as JSON (the CI gate) or a table.
+
+Entry points: ``python -m distributed_active_learning_tpu.analysis``,
+``run.py --audit``, ``bench.py --audit``.
+"""
+
+from distributed_active_learning_tpu.analysis.report import (  # noqa: F401
+    Finding,
+    Report,
+    SEVERITIES,
+    severity_rank,
+)
+from distributed_active_learning_tpu.analysis.auditor import (  # noqa: F401
+    AuditUnit,
+    audit_unit,
+    run_audit,
+)
+from distributed_active_learning_tpu.analysis.programs import (  # noqa: F401
+    ProgramSpec,
+    SkipProgram,
+    build_registry,
+    specs_for_experiment,
+)
+from distributed_active_learning_tpu.analysis.lint import (  # noqa: F401
+    default_lint_targets,
+    lint_paths,
+)

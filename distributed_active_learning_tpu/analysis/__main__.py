@@ -1,0 +1,129 @@
+"""CLI for the static program auditor.
+
+    python -m distributed_active_learning_tpu.analysis [--json] \
+        [--kinds chunk,sweep] [--strategies uncertainty,density] \
+        [--placements cpu,mesh4x2] [--fail-on error|warn|info]
+
+Exit code 0 when no finding reaches the ``--fail-on`` threshold, 1 otherwise
+— the tier-1 ``analysis`` CI job gates on exactly this. ``--rules`` prints
+the live rule table (jaxpr + lint registries).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+# Route the audit onto an 8-virtual-device CPU platform. `python -m
+# pkg.analysis` imports the parent package (and therefore jax) BEFORE this
+# module runs, and jax latches JAX_PLATFORMS from the environment at import
+# time — so the env-var route is already too late here. The config route is
+# not: platform and XLA_FLAGS are only consumed at FIRST BACKEND USE, which
+# hasn't happened yet (the package imports never touch devices). Mirrors
+# tests/conftest.py, which faces the same pre-imported-jax constraint.
+import jax  # noqa: E402
+
+if "JAX_PLATFORMS" not in os.environ:  # an explicit platform wins
+    jax.config.update("jax_platforms", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+try:
+    # jax >= 0.5 spelling; on 0.4.x the XLA_FLAGS route above carries it
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    pass
+
+import argparse  # noqa: E402
+
+
+def _csv(value):
+    return [v.strip() for v in value.split(",") if v.strip()] if value else None
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="distributed_active_learning_tpu.analysis",
+        description="jaxpr-level invariant audit + recompile-hazard lint",
+    )
+    ap.add_argument("--json", action="store_true", help="machine-readable report")
+    ap.add_argument(
+        "--strategies", type=_csv, default=None,
+        help="comma-separated strategy names (default: all registered)",
+    )
+    ap.add_argument(
+        "--kinds", type=_csv, default=None,
+        help="comma-separated program kinds: chunk,sweep,neural_chunk",
+    )
+    ap.add_argument(
+        "--placements", type=_csv, default=None,
+        help="comma-separated placements: cpu,mesh4x2",
+    )
+    ap.add_argument(
+        "--fail-on", choices=["info", "warn", "error"], default="error",
+        help="exit 1 when any finding is at or above this severity "
+        "(default error)",
+    )
+    ap.add_argument(
+        "--no-lint", action="store_true",
+        help="skip the AST recompile-hazard pass (jaxpr audit only)",
+    )
+    ap.add_argument(
+        "--no-audit", action="store_true",
+        help="skip the jaxpr audit (lint only; no jax tracing)",
+    )
+    ap.add_argument(
+        "--list", action="store_true", help="list auditable programs and exit"
+    )
+    ap.add_argument(
+        "--rules", action="store_true", help="print the rule table and exit"
+    )
+    return ap
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    from distributed_active_learning_tpu.analysis import lint as lint_lib
+    from distributed_active_learning_tpu.analysis import rules as rules_lib
+    from distributed_active_learning_tpu.analysis.auditor import run_audit
+    from distributed_active_learning_tpu.analysis.programs import build_registry
+    from distributed_active_learning_tpu.analysis.report import Report
+
+    if args.rules:
+        print("jaxpr rules:")
+        for rule in rules_lib.default_rules():
+            print(f"  {rule.id:28s} [{rule.severity}] {rule.description}")
+        print("lint rules:")
+        for rule_id, severity, desc in lint_lib.iter_rule_table():
+            print(f"  {rule_id:28s} [{severity}] {desc}")
+        return 0
+
+    specs = build_registry(
+        strategies=args.strategies,
+        kinds=args.kinds,
+        placements=args.placements,
+    )
+    if args.list:
+        for spec in specs:
+            print(spec.name)
+        return 0
+
+    if args.no_audit:
+        report = Report()
+    else:
+        report = run_audit(specs)
+    if not args.no_lint:
+        report.extend(lint_lib.lint_paths(lint_lib.default_lint_targets()))
+
+    if args.json:
+        print(report.to_json())
+    else:
+        print(report.render_table())
+    return 1 if report.gate(args.fail_on) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

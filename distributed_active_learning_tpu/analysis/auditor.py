@@ -1,0 +1,194 @@
+"""The program auditor: trace registered programs abstractly, apply rules.
+
+Tracing (``jax.jit(...).trace(*abstract_args)``) runs the Python of a program
+once with ShapeDtypeStruct inputs and yields the full ClosedJaxpr plus output
+avals — no compilation, no device execution, seconds per program even for the
+scan-fused chunks. The auditor walks that jaxpr (and, for donation checks,
+the lowered MLIR's aliasing metadata) against the rule registry
+(analysis/rules.py) and reports structured findings (analysis/report.py).
+
+This is the static half of the invariant story; the runtime half (veto
+counts, recompile detection) rides the JSONL telemetry
+(``runtime/telemetry.py`` launch / ``launch_veto`` events).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+
+from distributed_active_learning_tpu.analysis import rules as rules_lib
+from distributed_active_learning_tpu.analysis.report import Finding, Report
+
+
+@dataclasses.dataclass
+class AuditUnit:
+    """One auditable program: a jitted callable plus its abstract inputs and
+    the invariants the builder promised (what the rules check against).
+
+    ``carry_in_argnums``/``carry_out_index`` name the launch-to-launch carry:
+    which top-level argument positions hold the carried state and which
+    top-level output position returns it (the chunk drivers thread out[0]
+    back into the state argument). ``None`` disables the carry rules.
+    """
+
+    name: str
+    fn: Any
+    args: Tuple[Any, ...]
+    allows_callbacks: bool = False
+    expect_donation: bool = False
+    with_metrics: bool = False
+    carry_in_argnums: Optional[Tuple[int, ...]] = None
+    carry_out_index: Optional[int] = None
+
+
+class TracedUnit:
+    """An :class:`AuditUnit` traced once, with everything rules consume
+    computed lazily and cached (several rules share the eqn walk; only the
+    donation rule needs the lowering)."""
+
+    def __init__(self, unit: AuditUnit):
+        self.unit = unit
+        self.name = unit.name
+        self.allows_callbacks = unit.allows_callbacks
+        self.expect_donation = unit.expect_donation
+        self.with_metrics = unit.with_metrics
+        self._traced = unit.fn.trace(*unit.args)
+        self._eqn_sites = None
+        self._avals = None
+        self._lowered_text = None
+        self._lowered_tried = False
+
+    @property
+    def jaxpr(self):
+        return self._traced.jaxpr
+
+    @property
+    def eqn_sites(self):
+        if self._eqn_sites is None:
+            self._eqn_sites = list(rules_lib.iter_eqns(self.jaxpr.jaxpr))
+        return self._eqn_sites
+
+    @property
+    def avals(self):
+        if self._avals is None:
+            self._avals = list(rules_lib.iter_avals(self.jaxpr.jaxpr))
+        return self._avals
+
+    @property
+    def out_avals(self):
+        return list(self.jaxpr.out_avals)
+
+    @property
+    def out_tree_repr(self) -> str:
+        return str(jax.tree_util.tree_structure(self._traced.out_info))
+
+    @property
+    def lowered_text(self) -> Optional[str]:
+        if not self._lowered_tried:
+            self._lowered_tried = True
+            try:
+                self._lowered_text = self._traced.lower().as_text()
+            except Exception:
+                self._lowered_text = None
+        return self._lowered_text
+
+    # -- carry aval bookkeeping ---------------------------------------------
+
+    def _flat_arg_ranges(self) -> List[Tuple[int, int]]:
+        """Flat-aval index range of each top-level positional argument (the
+        jaxpr's invars are the flattened args in order)."""
+        ranges = []
+        offset = 0
+        for a in self.unit.args:
+            n = len(jax.tree_util.tree_leaves(a))
+            ranges.append((offset, offset + n))
+            offset += n
+        return ranges
+
+    @property
+    def carry_in_avals(self):
+        if self.unit.carry_in_argnums is None:
+            return None
+        in_avals = self.jaxpr.in_avals
+        out = []
+        ranges = self._flat_arg_ranges()
+        for argnum in self.unit.carry_in_argnums:
+            lo, hi = ranges[argnum]
+            out.extend(in_avals[lo:hi])
+        return out
+
+    @property
+    def carry_out_avals(self):
+        if self.unit.carry_out_index is None:
+            return None
+        out_info = self._traced.out_info
+        # top-level output position -> flat range, same arithmetic as args
+        ranges = []
+        offset = 0
+        for part in out_info:
+            n = len(jax.tree_util.tree_leaves(part))
+            ranges.append((offset, offset + n))
+            offset += n
+        lo, hi = ranges[self.unit.carry_out_index]
+        return self.jaxpr.out_avals[lo:hi]
+
+
+def audit_unit(
+    unit: AuditUnit, rules: Optional[Sequence[rules_lib.Rule]] = None
+) -> List[Finding]:
+    """Trace one program and run every rule over it. A program that fails to
+    TRACE is itself an error finding — an untraceable registered program
+    means the audit surface regressed, not that the program is clean."""
+    try:
+        traced = TracedUnit(unit)
+    except Exception as e:  # noqa: BLE001 - converted into a finding
+        return [
+            Finding(
+                rule="trace-failure",
+                severity="error",
+                program=unit.name,
+                location="<trace>",
+                message=f"{type(e).__name__}: {e}",
+            )
+        ]
+    findings: List[Finding] = []
+    for rule in rules or rules_lib.default_rules():
+        findings.extend(rule.check(traced))
+    return findings
+
+
+def run_audit(
+    specs,
+    rules: Optional[Sequence[rules_lib.Rule]] = None,
+) -> Report:
+    """Audit a list of :class:`~analysis.programs.ProgramSpec`; returns the
+    aggregate :class:`Report`. Specs whose builder declines (e.g. a mesh
+    variant without enough devices) land in ``report.skipped`` with the
+    builder's reason rather than vanishing."""
+    from distributed_active_learning_tpu.analysis.programs import SkipProgram
+
+    report = Report()
+    for spec in specs:
+        try:
+            unit = spec.build()
+        except SkipProgram as skip:
+            report.skipped[spec.name] = str(skip)
+            continue
+        except Exception as e:  # noqa: BLE001 - a broken builder is a finding
+            report.programs.append(spec.name)
+            report.findings.append(
+                Finding(
+                    rule="build-failure",
+                    severity="error",
+                    program=spec.name,
+                    location="<build>",
+                    message=f"{type(e).__name__}: {e}",
+                )
+            )
+            continue
+        report.programs.append(spec.name)
+        report.extend(audit_unit(unit, rules=rules))
+    return report

@@ -1,0 +1,320 @@
+"""Recompile-hazard linter: a lightweight AST pass over driver code.
+
+The jaxpr auditor sees what a program TRACED to; this pass catches hazards
+that live in the Python around the trace and may never show up in a single
+tracing — values that leak host round-trips or silent retraces:
+
+- ``DAL101 block-until-ready-in-library``: ``.block_until_ready()`` /
+  ``jax.block_until_ready()`` in library code serializes the async dispatch
+  stream. Legitimate uses (honest phase timing in the per-round drivers)
+  carry an inline waiver.
+- ``DAL102 host-cast-in-traced-code``: ``float()``/``int()``/``bool()`` on a
+  value inside a jit-decorated function is a trace-time ConcretizationError
+  at best, a silently-baked constant at worst.
+- ``DAL103 mutable-closure-in-jit``: a jitted function closing over an
+  enclosing-scope name that is rebound (re-assigned/augmented) — the trace
+  bakes whichever value was live, and later mutations silently don't apply
+  (or force a retrace via static-arg changes).
+- ``DAL104 dict-ordered-static-arg``: ``tuple(d.items())``/``list(d.items())``
+  hash by insertion order; two equal configs built in different orders then
+  miss the jit cache and recompile. Use ``sorted(d.items())``.
+
+Waivers: append ``# audit: ok`` (any rule) or ``# audit: ok[DAL101]`` (one
+rule) to the offending line — any line of a multi-line call works. For
+DAL103 (whose finding anchors to the jitted function itself) put the waiver
+on the ``def`` line or a decorator line; waivers inside the body are
+deliberately ignored, so one comment can't blanket a whole function.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from distributed_active_learning_tpu.analysis.report import Finding
+
+LINT_RULES: Dict[str, Tuple[str, str]] = {
+    "DAL101": ("warn", "block_until_ready in library code serializes dispatch"),
+    "DAL102": ("error", "float()/int()/bool() on a traced value inside jit"),
+    "DAL103": ("warn", "jitted function closes over a mutated enclosing name"),
+    "DAL104": ("warn", "tuple(dict.items()) hashes by insertion order"),
+}
+
+_WAIVER_RE = re.compile(r"#\s*audit:\s*ok(?:\[(?P<rules>[A-Z0-9,\s]+)\])?")
+
+
+def _waivers(source: str) -> Dict[int, Optional[Set[str]]]:
+    """Line number -> waived rule ids (None = all rules waived)."""
+    out: Dict[int, Optional[Set[str]]] = {}
+    for i, line in enumerate(source.splitlines(), start=1):
+        m = _WAIVER_RE.search(line)
+        if m:
+            rules = m.group("rules")
+            out[i] = (
+                None if rules is None
+                else {r.strip() for r in rules.split(",") if r.strip()}
+            )
+    return out
+
+
+def _is_jit_decorator(node: ast.expr) -> bool:
+    """Matches @jax.jit, @jit, @jax.jit(...), @functools.partial(jax.jit, ...)."""
+
+    def names(expr: ast.expr) -> str:
+        if isinstance(expr, ast.Attribute):
+            return f"{names(expr.value)}.{expr.attr}"
+        if isinstance(expr, ast.Name):
+            return expr.id
+        return ""
+
+    if names(node) in ("jax.jit", "jit"):
+        return True
+    if isinstance(node, ast.Call):
+        fn = names(node.func)
+        if fn in ("jax.jit", "jit"):
+            return True
+        if fn in ("functools.partial", "partial") and node.args:
+            return names(node.args[0]) in ("jax.jit", "jit")
+    return False
+
+
+def _bound_names(fn: ast.AST) -> Set[str]:
+    """Names bound in ONE function's own scope (params + assignments +
+    imports + nested def/class names), not descending into nested scopes."""
+    bound: Set[str] = set()
+    if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        a = fn.args
+        for arg in (
+            list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)
+            + ([a.vararg] if a.vararg else []) + ([a.kwarg] if a.kwarg else [])
+        ):
+            bound.add(arg.arg)
+
+    def walk(node: ast.AST):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                bound.add(child.name)
+                continue  # nested scope: its bindings are its own
+            if isinstance(child, ast.Lambda):
+                continue
+            if isinstance(child, ast.Name) and isinstance(child.ctx, (ast.Store, ast.Del)):
+                bound.add(child.id)
+            if isinstance(child, (ast.Import, ast.ImportFrom)):
+                for alias in child.names:
+                    bound.add((alias.asname or alias.name).split(".")[0])
+            walk(child)
+
+    walk(fn)
+    return bound
+
+
+def _rebound_names(fn: ast.AST) -> Set[str]:
+    """Names bound MORE than once (or augmented / loop-bound) in one
+    function's own scope — the mutation half of DAL103."""
+    counts: Dict[str, int] = {}
+
+    def bump(name: str, n: int = 1):
+        counts[name] = counts.get(name, 0) + n
+
+    def walk(node: ast.AST):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)):
+                continue
+            if isinstance(child, ast.AugAssign) and isinstance(child.target, ast.Name):
+                bump(child.target.id, 2)  # augmenting is inherently a rebind
+            elif isinstance(child, (ast.For, ast.AsyncFor)):
+                for t in ast.walk(child.target):
+                    if isinstance(t, ast.Name) and isinstance(t.ctx, ast.Store):
+                        bump(t.id, 2)  # loop vars rebind per iteration
+            elif isinstance(child, ast.Name) and isinstance(child.ctx, ast.Store):
+                bump(child.id)
+            walk(child)
+
+    walk(fn)
+    return {name for name, n in counts.items() if n > 1}
+
+
+def _loaded_names(fn: ast.AST) -> Set[str]:
+    """Names LOADED anywhere inside a function, nested scopes included
+    (a nested def's closure reads count against the jitted boundary)."""
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            out.add(node.id)
+    return out
+
+
+def _dotted(expr: ast.expr) -> str:
+    if isinstance(expr, ast.Attribute):
+        return f"{_dotted(expr.value)}.{expr.attr}"
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return ""
+
+
+class _Linter(ast.NodeVisitor):
+    def __init__(self, relpath: str, source: str):
+        self.relpath = relpath
+        self.waivers = _waivers(source)
+        self.findings: List[Finding] = []
+        self._fn_stack: List[ast.AST] = []   # enclosing FunctionDefs
+        self._jit_depth = 0                  # inside a jit-decorated def?
+
+    def _waived(self, rule: str, lines) -> bool:
+        for line in lines:
+            waived = self.waivers.get(line)
+            if line in self.waivers and (waived is None or rule in waived):
+                return True
+        return False
+
+    def _emit(self, rule: str, node: ast.AST, message: str):
+        line = getattr(node, "lineno", 0)
+        # A waiver anywhere on the node's own line span counts: a multi-line
+        # call's `# audit: ok[...]` naturally lands on its closing line, not
+        # its first. Function nodes (DAL103) check only their header — the
+        # decorators and the `def` line — so a waiver inside the body can't
+        # silently blanket the whole function.
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            lines = [d.lineno for d in node.decorator_list] + [node.lineno]
+        else:
+            lines = range(line, getattr(node, "end_lineno", line) + 1)
+        if self._waived(rule, lines):
+            return
+        severity, _ = LINT_RULES[rule]
+        self.findings.append(
+            Finding(
+                rule=rule,
+                severity=severity,
+                program=self.relpath,
+                location=f"{self.relpath}:{line}",
+                message=message,
+            )
+        )
+
+    # -- function scopes ----------------------------------------------------
+
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        self._visit_fn(node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef):
+        self._visit_fn(node)
+
+    def _visit_fn(self, node):
+        jitted = any(_is_jit_decorator(d) for d in node.decorator_list)
+        if jitted:
+            self._check_mutable_closure(node)
+        self._fn_stack.append(node)
+        self._jit_depth += int(jitted)
+        self.generic_visit(node)
+        self._jit_depth -= int(jitted)
+        self._fn_stack.pop()
+
+    def _check_mutable_closure(self, fn: ast.AST):
+        """DAL103: free names of a jitted def that some enclosing FUNCTION
+        scope both binds and rebinds."""
+        free = _loaded_names(fn) - _bound_names(fn)
+        for enclosing in reversed(self._fn_stack):
+            bound = _bound_names(enclosing)
+            rebound = _rebound_names(enclosing)
+            for name in sorted(free & bound & rebound):
+                self._emit(
+                    "DAL103", fn,
+                    f"jitted `{getattr(fn, 'name', '<fn>')}` closes over "
+                    f"`{name}`, which is rebound in the enclosing scope — the "
+                    "trace bakes whichever value was live at first call",
+                )
+            free -= bound  # resolved at this level; stop attributing upward
+
+    # -- calls --------------------------------------------------------------
+
+    def visit_Call(self, node: ast.Call):
+        fn = node.func
+        # DAL101: obj.block_until_ready() or jax.block_until_ready(x)
+        if isinstance(fn, ast.Attribute) and fn.attr == "block_until_ready":
+            self._emit(
+                "DAL101", node,
+                "block_until_ready in library code serializes the dispatch "
+                "stream; time at the driver boundary or waive with "
+                "`# audit: ok[DAL101]` where the sync is the point",
+            )
+        # DAL102: float()/int()/bool() under a jit-decorated function
+        if (
+            self._jit_depth > 0
+            and isinstance(fn, ast.Name)
+            and fn.id in ("float", "int", "bool")
+            and node.args
+        ):
+            self._emit(
+                "DAL102", node,
+                f"{fn.id}() inside a jit-traced function concretizes a "
+                "traced value (ConcretizationTypeError at best, a baked "
+                "constant at worst)",
+            )
+        # DAL104: tuple(d.items()) / list(d.items())
+        if (
+            isinstance(fn, ast.Name)
+            and fn.id in ("tuple", "list")
+            and len(node.args) == 1
+            and isinstance(node.args[0], ast.Call)
+            and isinstance(node.args[0].func, ast.Attribute)
+            and node.args[0].func.attr == "items"
+        ):
+            self._emit(
+                "DAL104", node,
+                f"{fn.id}(...items()) preserves dict insertion order; as a "
+                "jit static arg two equal configs can hash differently and "
+                "recompile — use sorted(...items())",
+            )
+        self.generic_visit(node)
+
+
+def lint_file(path: str, relpath: Optional[str] = None) -> List[Finding]:
+    rel = relpath or os.path.basename(path)
+    with open(path) as f:
+        source = f.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [
+            Finding(
+                rule="lint-parse-failure",
+                severity="error",
+                program=rel,
+                location=f"{rel}:{e.lineno or 0}",
+                message=str(e),
+            )
+        ]
+    linter = _Linter(rel, source)
+    linter.visit(tree)
+    return linter.findings
+
+
+def default_lint_targets(root: Optional[str] = None) -> List[str]:
+    """The driver surfaces the recompile hazards live in: ``runtime/`` and
+    ``strategies/`` of the installed package."""
+    if root is None:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    targets = []
+    for sub in ("runtime", "strategies"):
+        d = os.path.join(root, sub)
+        for fn in sorted(os.listdir(d)):
+            if fn.endswith(".py"):
+                targets.append(os.path.join(d, fn))
+    return targets
+
+
+def lint_paths(paths: Sequence[str], root: Optional[str] = None) -> List[Finding]:
+    if root is None and paths:
+        root = os.path.commonpath([os.path.dirname(os.path.abspath(p)) for p in paths])
+    findings: List[Finding] = []
+    for p in paths:
+        rel = os.path.relpath(p, root) if root else os.path.basename(p)
+        findings.extend(lint_file(p, rel))
+    return findings
+
+
+def iter_rule_table() -> Iterator[Tuple[str, str, str]]:
+    for rule_id, (severity, desc) in LINT_RULES.items():
+        yield rule_id, severity, desc

@@ -1,0 +1,417 @@
+"""Registry of auditable programs: every fused launch shape the drivers run.
+
+The drivers assemble their jitted programs from (strategy, loop kind, mesh)
+at run time; this module rebuilds the same programs with ABSTRACT inputs
+(ShapeDtypeStructs over tiny audit shapes) so the auditor can trace them
+without data, devices beyond the host, or compilation:
+
+- ``chunk``        — the scan-fused forest AL chunk (runtime/loop.py
+                     ``make_chunk_fn``), per registered strategy;
+- ``sweep``        — the vmapped experiment-batched chunk (runtime/sweep.py
+                     ``make_sweep_chunk_fn``), per registered strategy;
+- ``neural_chunk`` — the fused neural AL chunk (runtime/neural_loop.py
+                     ``make_neural_chunk_fn``), per fusable deep strategy.
+
+Each kind comes in two placements: ``cpu`` (single device) and ``mesh4x2``
+(the 4x2 data x model mesh with the pallas kernel shard_map-wrapped — the
+placement where collective and sharding invariants actually bite). The
+neural loop shards pool rows only (``mesh model > 1`` is refused by the
+driver), so its mesh variant is the same traced program and is not
+duplicated here.
+
+Audit shapes are deliberately tiny (64-row pool, 8 trees): rules check
+program STRUCTURE (primitives, avals, aliasing metadata), which is invariant
+to array sizes, and tracing stays at seconds for the whole matrix.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from distributed_active_learning_tpu.analysis.auditor import AuditUnit
+
+#: Audit shapes: small, mesh-divisible (pool % 4 == 0, trees % 2 == 0).
+POOL_ROWS = 64
+FEATURES = 4
+N_TREES = 8
+MAX_DEPTH = 3
+MAX_BINS = 8
+WINDOW = 5
+CHUNK_ROUNDS = 3
+TEST_ROWS = 16
+SWEEP_E = 3
+LABEL_CAP = 40
+FIT_BUDGET = 48
+
+KINDS = ("chunk", "sweep", "neural_chunk")
+PLACEMENTS = ("cpu", "mesh4x2")
+MESH_SHAPE = (4, 2)
+
+
+class SkipProgram(Exception):
+    """Raised by a builder whose program cannot be constructed here (e.g. a
+    mesh variant without enough devices); recorded as skipped, not clean."""
+
+
+@dataclasses.dataclass(frozen=True)
+class ProgramSpec:
+    """A named, lazily-built auditable program."""
+
+    name: str
+    kind: str
+    strategy: str
+    placement: str
+    build: Callable[[], AuditUnit]
+
+
+# ---------------------------------------------------------------------------
+# abstract input helpers
+# ---------------------------------------------------------------------------
+
+def _sds(shape, dtype) -> jax.ShapeDtypeStruct:
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _key_sds(shape=()):
+    if shape == ():
+        return jax.eval_shape(lambda: jax.random.key(0))
+    return jax.eval_shape(lambda: jax.random.split(jax.random.key(0), shape[0]))
+
+
+def _abstract_state(n=POOL_ROWS, d=FEATURES):
+    from distributed_active_learning_tpu.runtime import state as state_lib
+
+    return state_lib.PoolState(
+        x=_sds((n, d), jnp.float32),
+        oracle_y=_sds((n,), jnp.int32),
+        labeled_mask=_sds((n,), jnp.bool_),
+        key=_key_sds(),
+        round=_sds((), jnp.int32),
+    )
+
+
+def _abstract_lal_forest():
+    """A regressor-shaped PackedForest of abstract leaves — the LAL strategy
+    only routes avals through it during tracing."""
+    from distributed_active_learning_tpu.ops.trees import PackedForest
+
+    n_nodes = 2 ** (MAX_DEPTH + 1) - 1
+    t = 4
+    return PackedForest(
+        feature=_sds((t, n_nodes), jnp.int32),
+        threshold=_sds((t, n_nodes), jnp.float32),
+        left=_sds((t, n_nodes), jnp.int32),
+        right=_sds((t, n_nodes), jnp.int32),
+        value=_sds((t, n_nodes), jnp.float32),
+        max_depth=MAX_DEPTH,
+    )
+
+
+def _mesh_or_skip(shape=MESH_SHAPE):
+    data, model = shape
+    if len(jax.devices()) < data * model:
+        raise SkipProgram(
+            f"mesh{data}x{model} needs {data * model} devices, "
+            f"have {len(jax.devices())}"
+        )
+    from distributed_active_learning_tpu.parallel import make_mesh
+
+    return make_mesh(data=data, model=model)
+
+
+def _forest_cfg(kernel: str):
+    from distributed_active_learning_tpu.config import (
+        ExperimentConfig,
+        ForestConfig,
+        StrategyConfig,
+    )
+
+    return ExperimentConfig(
+        forest=ForestConfig(
+            n_trees=N_TREES, max_depth=MAX_DEPTH, max_bins=MAX_BINS,
+            kernel=kernel, fit="device",
+        ),
+        strategy=StrategyConfig(name="uncertainty", window_size=WINDOW),
+    )
+
+
+def _device_fit(kernel: str):
+    from distributed_active_learning_tpu.runtime.loop import make_device_fit
+
+    edges = jnp.zeros((FEATURES, MAX_BINS - 1), jnp.float32)
+    return make_device_fit(_forest_cfg(kernel), edges, FIT_BUDGET, n_classes=2)
+
+
+def _strategy_and_aux(name: str):
+    from distributed_active_learning_tpu.config import StrategyConfig
+    from distributed_active_learning_tpu.strategies import StrategyAux, get_strategy
+
+    strategy = get_strategy(StrategyConfig(name=name, window_size=WINDOW))
+    lal = _abstract_lal_forest() if name == "lal" else None
+    aux = StrategyAux(lal_forest=lal, seed_mask=_sds((POOL_ROWS,), jnp.bool_))
+    return strategy, aux
+
+
+# ---------------------------------------------------------------------------
+# builders
+# ---------------------------------------------------------------------------
+
+def _build_chunk(
+    strategy_name: str, placement: str, mesh_shape=MESH_SHAPE
+) -> AuditUnit:
+    from distributed_active_learning_tpu.runtime.loop import make_chunk_fn
+
+    mesh = _mesh_or_skip(mesh_shape) if placement != "cpu" else None
+    kernel = "pallas" if mesh is not None else "gemm"
+    strategy, aux = _strategy_and_aux(strategy_name)
+    chunk_fn = make_chunk_fn(
+        strategy, WINDOW, CHUNK_ROUNDS, _device_fit(kernel), LABEL_CAP,
+        mesh=mesh,
+        wrap_pallas=mesh is not None,
+        with_metrics=True,
+        n_classes=2,
+    )
+    args = (
+        _sds((POOL_ROWS, FEATURES), jnp.int32),     # codes
+        _abstract_state(),                           # state (donated carry)
+        aux,
+        _key_sds(),                                  # fit_key
+        _sds((TEST_ROWS, FEATURES), jnp.float32),    # test_x
+        _sds((TEST_ROWS,), jnp.int32),               # test_y
+        _sds((), jnp.int32),                         # end_round
+    )
+    return AuditUnit(
+        name=f"chunk/{strategy_name}/{placement}",
+        fn=chunk_fn,
+        args=args,
+        expect_donation=True,
+        with_metrics=True,
+        carry_in_argnums=(1,),
+        carry_out_index=0,
+    )
+
+
+def _build_sweep(
+    strategy_name: str, placement: str, mesh_shape=MESH_SHAPE
+) -> AuditUnit:
+    from distributed_active_learning_tpu.runtime.sweep import (
+        SweepState,
+        make_sweep_chunk_fn,
+    )
+
+    mesh = _mesh_or_skip(mesh_shape) if placement != "cpu" else None
+    kernel = "pallas" if mesh is not None else "gemm"
+    strategy, aux = _strategy_and_aux(strategy_name)
+    sweep_fn = make_sweep_chunk_fn(
+        strategy, WINDOW, CHUNK_ROUNDS, _device_fit(kernel), LABEL_CAP,
+        n_valid_static=-1,
+        mesh=mesh,
+        wrap_pallas=mesh is not None,
+        with_metrics=True,
+        n_classes=2,
+    )
+    e = SWEEP_E
+    sweep_state = SweepState(
+        labeled_mask=_sds((e, POOL_ROWS), jnp.bool_),
+        key=_key_sds((e,)),
+        round=_sds((e,), jnp.int32),
+    )
+    args = (
+        _sds((POOL_ROWS, FEATURES), jnp.int32),      # codes
+        _sds((POOL_ROWS, FEATURES), jnp.float32),    # x
+        _sds((POOL_ROWS,), jnp.int32),               # oracle_y
+        sweep_state,                                  # donated carry
+        _sds((e, POOL_ROWS), jnp.bool_),             # seed_masks
+        aux.lal_forest,                               # lal_forest
+        _key_sds((e,)),                               # fit_keys
+        _sds((e,), jnp.int32),                       # windows
+        _sds((TEST_ROWS, FEATURES), jnp.float32),    # test_x
+        _sds((TEST_ROWS,), jnp.int32),               # test_y
+        _sds((e,), jnp.int32),                       # end_rounds
+    )
+    return AuditUnit(
+        name=f"sweep/{strategy_name}/{placement}",
+        fn=sweep_fn,
+        args=args,
+        expect_donation=True,
+        with_metrics=True,
+        carry_in_argnums=(3,),
+        carry_out_index=0,
+    )
+
+
+def _build_neural_chunk(strategy_name: str, placement: str) -> AuditUnit:
+    from distributed_active_learning_tpu.models.neural import MLP, NeuralLearner
+    from distributed_active_learning_tpu.runtime import state as state_lib
+    from distributed_active_learning_tpu.runtime.neural_loop import (
+        make_neural_chunk_fn,
+    )
+
+    if placement != "cpu":
+        raise SkipProgram(
+            "the neural loop shards pool rows only (mesh model > 1 is "
+            "refused by the driver); its traced program has no mesh variant"
+        )
+    learner = NeuralLearner(
+        MLP(n_classes=2, hidden=(8,)),
+        input_shape=(FEATURES,),
+        train_steps=2,
+        mc_samples=2,
+    )
+    chunk_fn = make_neural_chunk_fn(
+        learner, strategy_name, WINDOW, CHUNK_ROUNDS, LABEL_CAP,
+        with_metrics=True,
+        n_classes=2,
+    )
+    net_sds = jax.eval_shape(learner.init, _key_sds())
+    state = state_lib.PoolState(
+        x=_sds((POOL_ROWS, 0), jnp.float32),  # placeholder, like the driver
+        oracle_y=_sds((POOL_ROWS,), jnp.int32),
+        labeled_mask=_sds((POOL_ROWS,), jnp.bool_),
+        key=_key_sds(),
+        round=_sds((), jnp.int32),
+    )
+    args = (
+        net_sds,                                      # net_state
+        state,                                        # pool state
+        _key_sds(),                                   # loop key
+        _sds((POOL_ROWS, FEATURES), jnp.float32),     # pool_x
+        net_sds,                                      # init_net
+        _sds((TEST_ROWS, FEATURES), jnp.float32),     # test_x
+        _sds((TEST_ROWS,), jnp.int32),                # test_y
+        _sds((), jnp.int32),                          # end_round
+    )
+    return AuditUnit(
+        name=f"neural_chunk/{strategy_name}/{placement}",
+        fn=chunk_fn,
+        args=args,
+        expect_donation=False,  # un-donated by design (checkpointing touchdown)
+        with_metrics=True,
+        carry_in_argnums=(0, 1, 2),
+        carry_out_index=0,
+    )
+
+
+# ---------------------------------------------------------------------------
+# the registry
+# ---------------------------------------------------------------------------
+
+def forest_strategy_names() -> List[str]:
+    from distributed_active_learning_tpu.strategies import available_strategies
+
+    return list(available_strategies())
+
+
+def neural_strategy_names() -> List[str]:
+    from distributed_active_learning_tpu.runtime.neural_loop import (
+        FUSABLE_STRATEGIES,
+    )
+
+    return sorted(FUSABLE_STRATEGIES)
+
+
+def build_registry(
+    strategies: Optional[Sequence[str]] = None,
+    kinds: Optional[Sequence[str]] = None,
+    placements: Optional[Sequence[str]] = None,
+) -> List[ProgramSpec]:
+    """All auditable programs, optionally filtered by strategy name, kind
+    (``chunk``/``sweep``/``neural_chunk``), and placement
+    (``cpu``/``mesh4x2``)."""
+    kinds = tuple(kinds) if kinds else KINDS
+    placements = tuple(placements) if placements else PLACEMENTS
+    for k in kinds:
+        if k not in KINDS:
+            raise ValueError(f"unknown kind {k!r}; one of {KINDS}")
+    for p in placements:
+        if p not in PLACEMENTS:
+            raise ValueError(f"unknown placement {p!r}; one of {PLACEMENTS}")
+    specs: List[ProgramSpec] = []
+
+    def want(name: str) -> bool:
+        return strategies is None or name in strategies
+
+    for kind, builder, names in (
+        ("chunk", _build_chunk, forest_strategy_names()),
+        ("sweep", _build_sweep, forest_strategy_names()),
+        ("neural_chunk", _build_neural_chunk, neural_strategy_names()),
+    ):
+        if kind not in kinds:
+            continue
+        # the neural loop has a single (cpu) placement — emit it only when
+        # cpu was requested, so a mesh-only filter doesn't smuggle cpu
+        # programs back into the audit
+        kind_placements = (
+            (("cpu",) if "cpu" in placements else ())
+            if kind == "neural_chunk"
+            else placements
+        )
+        for name in names:
+            if not want(name):
+                continue
+            for placement in kind_placements:
+                specs.append(
+                    ProgramSpec(
+                        name=f"{kind}/{name}/{placement}",
+                        kind=kind,
+                        strategy=name,
+                        placement=placement,
+                        build=functools.partial(builder, name, placement),
+                    )
+                )
+    return specs
+
+
+def specs_for_experiment(cfg, neural_strategy: Optional[str] = None) -> List[ProgramSpec]:
+    """The registry entries matching what ``run.py`` would launch for this
+    config: the neural chunk for a fusable deep strategy, the batched sweep
+    for ``sweep_seeds > 1``, the fused forest chunk otherwise (also the right
+    audit surface for a per-round run — the chunk wraps the same round
+    program).
+
+    Mesh configs are audited at the CONFIGURED (data, model) shape, not the
+    registry's fixed 4x2, so the traced program's collective/sharding
+    structure matches the run's. The one caveat: the audit's fixed tree
+    count (``N_TREES``) must divide the model axis — for a model width it
+    can't express, the 4x2 stand-in is used and named as such in the spec.
+    """
+    if neural_strategy is not None:
+        from distributed_active_learning_tpu.runtime.neural_loop import (
+            FUSABLE_STRATEGIES,
+        )
+
+        name = neural_strategy
+        if name not in FUSABLE_STRATEGIES:
+            # per-round-only strategies (batchbald/coreset/badge) have no
+            # fused program to audit; fall back to a fusable stand-in that
+            # shares the fit/predict pipeline
+            name = "entropy"
+        return build_registry(
+            strategies=[name], kinds=["neural_chunk"], placements=["cpu"]
+        )
+    kind = "sweep" if getattr(cfg, "sweep_seeds", 1) > 1 else "chunk"
+    if cfg.mesh.data * cfg.mesh.model <= 1:
+        return build_registry(
+            strategies=[cfg.strategy.name], kinds=[kind], placements=["cpu"]
+        )
+    shape = (cfg.mesh.data, cfg.mesh.model)
+    if N_TREES % shape[1]:
+        shape = MESH_SHAPE  # inexpressible model width: the 4x2 stand-in
+    builder = _build_chunk if kind == "chunk" else _build_sweep
+    placement = f"mesh{shape[0]}x{shape[1]}"
+    return [
+        ProgramSpec(
+            name=f"{kind}/{cfg.strategy.name}/{placement}",
+            kind=kind,
+            strategy=cfg.strategy.name,
+            placement=placement,
+            build=functools.partial(
+                builder, cfg.strategy.name, placement, mesh_shape=shape
+            ),
+        )
+    ]

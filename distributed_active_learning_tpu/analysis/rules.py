@@ -1,0 +1,357 @@
+"""Jaxpr-level invariant rules for the program auditor.
+
+Each rule inspects ONE traced program (an :class:`~analysis.auditor.TracedUnit`
+— the ClosedJaxpr, the output avals/structure, the lowered MLIR text) and
+yields :class:`~analysis.report.Finding` records. Rules are registered in
+``RULES`` by id; ``python -m distributed_active_learning_tpu.analysis --rules``
+prints the registry as the living rule table.
+
+The invariants these encode are exactly the ones the PR-2..PR-5 fast path
+depends on but nothing verified statically until now:
+
+- the fused scan must not hide host callbacks or device transfers (each one
+  serializes every scan step on a launch boundary);
+- declared buffer donation must actually alias (a donated-but-copied carry
+  silently doubles HBM traffic on pool-scale states);
+- no f64/weak-type avals may leak into programs or their boundary outputs
+  (a weak output rebound as the next launch's input retriggers compilation);
+- shard_map'd forest ops must not smuggle in unexpected gathers;
+- the metrics contract (``with_metrics=True`` => RoundMetrics in the ys) must
+  hold, or fused runs silently lose their per-round observability.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+import jax
+
+from distributed_active_learning_tpu.analysis.report import Finding
+
+core = jax.core  # 0.4.x: ClosedJaxpr/Jaxpr both live here
+
+# ---------------------------------------------------------------------------
+# jaxpr walking
+# ---------------------------------------------------------------------------
+
+#: Host-callback primitives: their presence in a fused program means every
+#: scan step funnels through the host runtime (the exact overhead the chunked
+#: driver exists to remove). ``--stream-rounds`` opts into debug_callback.
+CALLBACK_PRIMITIVES = frozenset({"pure_callback", "debug_callback", "io_callback"})
+
+#: Collectives allowed inside a shard_map region: psum is the sharded vote /
+#: bookkeeping reduction (parallel/kernels.py, collectives.py), ppermute the
+#: ring schedule (ops/ring_attention.py), axis_index free. all_gather /
+#: all_to_all rematerialize a full axis per shard — the r4-style silent
+#: bandwidth cliff this rule exists to catch.
+SHARD_MAP_ALLOWED_COLLECTIVES = frozenset({"psum", "ppermute", "axis_index", "pmin", "pmax"})
+SHARD_MAP_FLAGGED_COLLECTIVES = frozenset({"all_gather", "all_to_all"})
+
+_64BIT_DTYPES = frozenset({"float64", "complex128", "int64", "uint64"})
+
+
+@dataclasses.dataclass(frozen=True)
+class EqnSite:
+    """One equation plus where it sits: the primitive path from the program
+    root (e.g. ``scan/pjit/scan``) and whether a shard_map encloses it."""
+
+    eqn: object
+    path: Tuple[str, ...]
+    in_shard_map: bool
+
+    @property
+    def location(self) -> str:
+        loc = "/".join(self.path) or "<top>"
+        src = _source_of(self.eqn)
+        return f"{loc}: {src}" if src else loc
+
+
+def _source_of(eqn) -> Optional[str]:
+    try:
+        from jax._src import source_info_util
+
+        src = source_info_util.summarize(eqn.source_info)
+        return src or None
+    except Exception:
+        return None
+
+
+def _sub_jaxprs(eqn) -> List[core.Jaxpr]:
+    subs: List[core.Jaxpr] = []
+    for v in eqn.params.values():
+        if isinstance(v, core.ClosedJaxpr):
+            subs.append(v.jaxpr)
+        elif isinstance(v, core.Jaxpr):
+            subs.append(v)
+        elif isinstance(v, (list, tuple)):
+            for item in v:
+                if isinstance(item, core.ClosedJaxpr):
+                    subs.append(item.jaxpr)
+                elif isinstance(item, core.Jaxpr):
+                    subs.append(item)
+    return subs
+
+
+def iter_eqns(jaxpr: core.Jaxpr) -> Iterator[EqnSite]:
+    """Depth-first walk over every equation, including those inside scan /
+    cond / pjit / shard_map / custom_* sub-jaxprs."""
+
+    def walk(jx: core.Jaxpr, path: Tuple[str, ...], in_sm: bool):
+        for eqn in jx.eqns:
+            name = eqn.primitive.name
+            yield EqnSite(eqn=eqn, path=path, in_shard_map=in_sm)
+            inner_sm = in_sm or name == "shard_map"
+            for sub in _sub_jaxprs(eqn):
+                yield from walk(sub, path + (name,), inner_sm)
+
+    yield from walk(jaxpr, (), False)
+
+
+def iter_avals(jaxpr: core.Jaxpr) -> Iterator[Tuple[str, object]]:
+    """Every aval in the program: boundary vars, closure constants, and each
+    equation's outputs, labeled with where it was seen."""
+    for v in jaxpr.invars:
+        yield "<input>", v.aval
+    for v in jaxpr.constvars:
+        # captured closure constants — a stray np.float64 scalar enters here,
+        # not through the declared inputs
+        yield "<const>", v.aval
+    for site in iter_eqns(jaxpr):
+        for v in site.eqn.outvars:
+            if hasattr(v, "aval"):
+                yield site.location, v.aval
+
+
+def _aval_str(aval) -> str:
+    try:
+        return aval.str_short()
+    except Exception:
+        return str(aval)
+
+
+# ---------------------------------------------------------------------------
+# rule registry
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    id: str
+    severity: str
+    description: str
+    check: Callable  # (TracedUnit) -> Iterator[Finding]
+
+
+RULES: Dict[str, Rule] = {}
+
+
+def register_rule(rule_id: str, severity: str, description: str):
+    def deco(fn):
+        RULES[rule_id] = Rule(
+            id=rule_id, severity=severity, description=description, check=fn
+        )
+        return fn
+
+    return deco
+
+
+def _finding(rule_id: str, unit, location: str, message: str) -> Finding:
+    rule = RULES[rule_id]
+    return Finding(
+        rule=rule_id,
+        severity=rule.severity,
+        program=unit.name,
+        location=location,
+        message=message,
+    )
+
+
+# ---------------------------------------------------------------------------
+# the rules
+# ---------------------------------------------------------------------------
+
+@register_rule(
+    "host-callback-in-fast-path",
+    "error",
+    "no pure_callback/debug_callback/io_callback inside a fused program "
+    "unless the program opted into round streaming (--stream-rounds)",
+)
+def _rule_host_callback(unit) -> Iterator[Finding]:
+    if unit.allows_callbacks:
+        return
+    for site in unit.eqn_sites:
+        if site.eqn.primitive.name in CALLBACK_PRIMITIVES:
+            yield _finding(
+                "host-callback-in-fast-path",
+                unit,
+                site.location,
+                f"{site.eqn.primitive.name} rides the traced fast path; every "
+                "scan step now funnels through the host callback runtime",
+            )
+
+
+@register_rule(
+    "device-transfer-in-fast-path",
+    "error",
+    "no device_put with a concrete destination inside a fused program "
+    "(alias-semantics puts with no target device are benign)",
+)
+def _rule_device_transfer(unit) -> Iterator[Finding]:
+    for site in unit.eqn_sites:
+        if site.eqn.primitive.name != "device_put":
+            continue
+        devices = site.eqn.params.get("devices", ())
+        if any(d is not None for d in devices):
+            yield _finding(
+                "device-transfer-in-fast-path",
+                unit,
+                site.location,
+                f"device_put with explicit destination {devices} inside the "
+                "traced program forces a placement/transfer per execution",
+            )
+
+
+@register_rule(
+    "f64-aval",
+    "error",
+    "no 64-bit (f64/c128/i64/u64) avals anywhere in the program — an x64 "
+    "leak doubles bandwidth on the whole downstream chain",
+)
+def _rule_f64(unit) -> Iterator[Finding]:
+    seen = set()
+    for where, aval in unit.avals:
+        dtype = getattr(aval, "dtype", None)
+        if dtype is not None and str(dtype) in _64BIT_DTYPES:
+            key = (where, str(dtype))
+            if key in seen:
+                continue
+            seen.add(key)
+            yield _finding(
+                "f64-aval", unit, where,
+                f"64-bit aval {_aval_str(aval)} in the traced program",
+            )
+
+
+@register_rule(
+    "weak-type-output",
+    "error",
+    "program outputs must not be weakly typed: a weak output rebound as the "
+    "next launch's input has a different aval and retriggers compilation",
+)
+def _rule_weak_output(unit) -> Iterator[Finding]:
+    for i, aval in enumerate(unit.out_avals):
+        if getattr(aval, "weak_type", False):
+            yield _finding(
+                "weak-type-output", unit, f"output[{i}]",
+                f"weakly-typed output {_aval_str(aval)}; rebinding it as an "
+                "input changes the aval and recompiles",
+            )
+
+
+@register_rule(
+    "carry-aval-drift",
+    "error",
+    "the carried state's output avals must equal its input avals exactly "
+    "(shape, dtype, weak type) so launch N+1 reuses launch N's executable",
+)
+def _rule_carry_drift(unit) -> Iterator[Finding]:
+    if unit.carry_in_avals is None or unit.carry_out_avals is None:
+        return
+    ins, outs = unit.carry_in_avals, unit.carry_out_avals
+    if len(ins) != len(outs):
+        yield _finding(
+            "carry-aval-drift", unit, "<carry>",
+            f"carry leaf count changed across the launch: {len(ins)} in, "
+            f"{len(outs)} out",
+        )
+        return
+    for i, (a_in, a_out) in enumerate(zip(ins, outs)):
+        same = (
+            getattr(a_in, "shape", None) == getattr(a_out, "shape", None)
+            and getattr(a_in, "dtype", None) == getattr(a_out, "dtype", None)
+            and getattr(a_in, "weak_type", False) == getattr(a_out, "weak_type", False)
+        )
+        if not same:
+            yield _finding(
+                "carry-aval-drift", unit, f"carry leaf [{i}]",
+                f"carry aval drifts across the launch: {_aval_str(a_in)} in "
+                f"vs {_aval_str(a_out)} out — the next dispatch recompiles",
+            )
+
+
+@register_rule(
+    "donation-not-aliased",
+    "error",
+    "a program built with donate_argnums must actually alias its donated "
+    "buffers to outputs (cross-checked against the lowering's "
+    "tf.aliasing_output / jax.buffer_donor metadata)",
+)
+def _rule_donation(unit) -> Iterator[Finding]:
+    if not unit.expect_donation:
+        return
+    text = unit.lowered_text
+    if text is None:
+        yield _finding(
+            "donation-not-aliased", unit, "<lowering>",
+            "program expects donation but could not be lowered to check "
+            "aliasing metadata",
+        )
+        return
+    # Two valid spellings of a live donation in the lowering: a resolved
+    # input-output alias (tf.aliasing_output — single-device programs, where
+    # jax matches avals itself) or a deferred donation handed to the
+    # compiler (jax.buffer_donor — sharded programs, where output shardings
+    # are GSPMD's to decide). A donated-but-UNUSABLE buffer gets NEITHER
+    # (jax strips it with the "donated buffers were not usable" warning) —
+    # that silent drop is the regression this rule exists to catch.
+    aliased = len(re.findall(r"tf\.aliasing_output", text))
+    donors = len(re.findall(r"jax\.buffer_donor", text))
+    if aliased == 0 and donors == 0:
+        yield _finding(
+            "donation-not-aliased", unit, "<lowering>",
+            "donation declared but no donated input survives to the "
+            "lowering (no tf.aliasing_output, no jax.buffer_donor) — the "
+            "carried state is copied every launch",
+        )
+
+
+@register_rule(
+    "collective-in-shard-map",
+    "error",
+    "no all_gather/all_to_all inside shard_map'd forest ops (psum/ppermute "
+    "are the sanctioned collectives); a gather rematerializes a full mesh "
+    "axis per shard",
+)
+def _rule_shard_map_collectives(unit) -> Iterator[Finding]:
+    for site in unit.eqn_sites:
+        if not site.in_shard_map:
+            continue
+        name = site.eqn.primitive.name
+        if name in SHARD_MAP_FLAGGED_COLLECTIVES:
+            yield _finding(
+                "collective-in-shard-map", unit, site.location,
+                f"{name} inside a shard_map region rematerializes the "
+                "sharded axis on every shard",
+            )
+
+
+@register_rule(
+    "metrics-missing",
+    "error",
+    "a program built with with_metrics=True must return the RoundMetrics "
+    "pytree in its ys (fused runs otherwise lose per-round observability "
+    "silently)",
+)
+def _rule_metrics(unit) -> Iterator[Finding]:
+    if not unit.with_metrics:
+        return
+    if "RoundMetrics" not in unit.out_tree_repr:
+        yield _finding(
+            "metrics-missing", unit, "<outputs>",
+            "with_metrics=True but no RoundMetrics node in the output tree",
+        )
+
+
+def default_rules() -> List[Rule]:
+    return list(RULES.values())

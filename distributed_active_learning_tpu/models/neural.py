@@ -19,7 +19,7 @@ Design notes (TPU-first):
 from __future__ import annotations
 
 import functools
-from typing import Any, Callable, NamedTuple, Optional, Tuple
+from typing import Any, Callable, NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -116,7 +116,16 @@ class NeuralLearner:
         params = self.module.init(
             {"params": key}, jnp.zeros((1, *self.input_shape)), train=False
         )["params"]
-        return TrainState(params=params, opt_state=self.tx.init(params), step=jnp.asarray(0))
+        # Explicit dtype: a bare asarray(0) is WEAKLY typed, and the weak
+        # step then rides the fused chunk's carry while a checkpoint-restored
+        # step (numpy round-trip) comes back strong — same program, two avals,
+        # a silent recompile on resume (flagged by the analysis auditor's
+        # weak-type-output rule).
+        return TrainState(
+            params=params,
+            opt_state=self.tx.init(params),
+            step=jnp.asarray(0, dtype=jnp.int32),
+        )
 
     @functools.partial(jax.jit, static_argnums=0)
     def fit_on_mask(

@@ -39,7 +39,6 @@ the pallas kernel's corrected device rate is ~12M scores/s. See
 
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 from flax import struct

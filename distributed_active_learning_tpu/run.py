@@ -129,6 +129,15 @@ def build_parser() -> argparse.ArgumentParser:
     # the data axis, trees the model axis; non-divisible pools are padded.
     ap.add_argument("--mesh-data", type=int, default=1)
     ap.add_argument("--mesh-model", type=int, default=1)
+    ap.add_argument(
+        "--audit", action="store_true",
+        help="statically audit the program this run would launch BEFORE "
+        "running it (analysis/ jaxpr auditor + recompile-hazard lint over "
+        "runtime/ and strategies/): traces the fused chunk/sweep/neural "
+        "program for this strategy and placement and refuses to run on any "
+        "error-severity finding. Seconds of tracing to rule out a silent "
+        "perf regression before hours of experiment",
+    )
     ap.add_argument("--quiet", action="store_true")
     ap.add_argument("--json", action="store_true", help="print per-round records as JSON lines")
     ap.add_argument("--list", action="store_true", help="list datasets and strategies")
@@ -273,6 +282,12 @@ def main(argv=None) -> int:
                 f"--neural needs a deep strategy, got {args.strategy!r}; "
                 f"pick one of: {', '.join(available_deep_strategies())}"
             )
+        if args.audit:
+            from distributed_active_learning_tpu.runtime.neural_loop import (
+                _normalize_deep_name,
+            )
+
+            _audit_or_die(args, neural_strategy=_normalize_deep_name(args.strategy))
         writer = _make_writer(args)
         try:
             with _profile(args):
@@ -324,6 +339,8 @@ def main(argv=None) -> int:
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every=args.checkpoint_every,
     )
+    if args.audit:
+        _audit_or_die(args, cfg=cfg)
     writer = _make_writer(args)
     try:
         with _profile(args):
@@ -342,6 +359,54 @@ def main(argv=None) -> int:
     else:
         _emit(args, result, dbg)
     return 0
+
+
+def _audit_or_die(args, cfg=None, neural_strategy=None):
+    """``--audit``: trace the fused program this configuration would launch
+    (plus the recompile-hazard lint over the driver surfaces) and refuse to
+    run on any error-severity finding. A mesh placement that cannot be
+    audited here (fewer than 8 devices on a CPU rig) falls back to the
+    single-device program — same strategy pipeline, still worth gating on."""
+    from distributed_active_learning_tpu.analysis import (
+        default_lint_targets,
+        lint_paths,
+        run_audit,
+        specs_for_experiment,
+    )
+
+    specs = specs_for_experiment(cfg, neural_strategy=neural_strategy)
+    report = run_audit(specs)
+    if not report.programs and report.skipped:
+        # every spec was skipped (mesh placement, too few devices): re-audit
+        # the same strategy/kind at the cpu placement instead of gating
+        # nothing — and SAY so, since the traced program then differs from
+        # the one the run launches
+        from distributed_active_learning_tpu.analysis import build_registry
+
+        print(
+            "# audit: mesh program unavailable here "
+            f"({'; '.join(report.skipped.values())}); auditing the "
+            "single-device program instead",
+            file=sys.stderr,
+        )
+        report = run_audit(
+            build_registry(
+                strategies=sorted({s.strategy for s in specs}),
+                kinds=sorted({s.kind for s in specs}),
+                placements=["cpu"],
+            )
+        )
+    report.extend(lint_paths(default_lint_targets()))
+    if report.findings:
+        print(report.render_table(), file=sys.stderr)
+    if report.gate("error"):
+        raise SystemExit(
+            "audit failed: error-severity findings in the traced program "
+            "(see above); fix them or re-run without --audit"
+        )
+    if not args.quiet:
+        audited = ", ".join(report.programs)
+        print(f"# audit clean: {audited}", file=sys.stderr)
 
 
 def _make_writer(args):

@@ -16,7 +16,6 @@ subset and a scalar accuracy.
 
 from __future__ import annotations
 
-import dataclasses
 import functools
 from typing import Optional, Tuple
 
@@ -28,7 +27,6 @@ from distributed_active_learning_tpu.config import ExperimentConfig
 from distributed_active_learning_tpu.data.datasets import DataBundle, get_dataset
 from distributed_active_learning_tpu.models.forest import (
     fit_forest_classifier,
-    fit_forest_regressor,
 )
 from distributed_active_learning_tpu.ops import forest_eval
 from distributed_active_learning_tpu.ops.topk import select_bottom_k, select_top_k
@@ -755,6 +753,7 @@ def run_experiment(
                 depth=depth,
                 on_launch=launches.record,
                 may_dispatch=ctl.may_dispatch,
+                on_veto=lambda idx: launches.veto(idx, ctl.veto_reason(idx)),
             )
 
         if cfg.results_path:
@@ -781,7 +780,8 @@ def run_experiment(
                 forest = place_forest(
                     device_fit(codes, state, jax.random.fold_in(fit_key, round_idx))
                 )
-                jax.block_until_ready(forest)  # keep phase timings honest
+                # keep phase timings honest
+                jax.block_until_ready(forest)  # audit: ok[DAL101]
             else:
                 lx, ly = _labeled_subset(state, host_x, host_y)
                 packed = fit_forest_classifier(
@@ -798,7 +798,7 @@ def run_experiment(
                 state, picked, _, rm = round_fn(forest, state, aux)
             else:
                 state, picked, _ = round_fn(forest, state, aux)
-            jax.block_until_ready(picked)
+            jax.block_until_ready(picked)  # audit: ok[DAL101] — phase timing
         score_time = dbg.records[-1][1]
         with dbg.phase("eval"):
             acc = float(_accuracy(forest, test_x, test_y))

@@ -351,7 +351,7 @@ def run_neural_experiment(
 
     sharded = cfg.mesh.data * cfg.mesh.model > 1
     if sharded:
-        from jax.sharding import NamedSharding, PartitionSpec as P
+        from jax.sharding import PartitionSpec as P
 
         mesh, state, pool_x, net_state = _place_on_mesh(
             cfg.mesh, state, pool_x, net_state
@@ -506,6 +506,7 @@ def run_neural_experiment(
                 depth=depth,
                 on_launch=launches.record,
                 may_dispatch=ctl.may_dispatch,
+                on_veto=lambda idx: launches.veto(idx, ctl.veto_reason(idx)),
             )
         if metrics is not None:
             mem = telemetry.device_memory_gauges()
@@ -539,7 +540,7 @@ def run_neural_experiment(
             )
             # keep phase timings honest: fit_on_mask returns async — without
             # the block its cost books under the acquire phase
-            jax.block_until_ready(net_state.params)
+            jax.block_until_ready(net_state.params)  # audit: ok[DAL101]
         train_time = dbg.records[-1][1]
 
         with dbg.phase("acquire"):
@@ -597,10 +598,10 @@ def run_neural_experiment(
                 n_unlabeled = n_pool - n_labeled
                 if n_unlabeled > cfg.batchbald_candidate_pool:
                     dbg.debug(
-                        f"batchbald: candidate pool truncated to top "
+                        "batchbald: candidate pool truncated to top "
                         f"{cfg.batchbald_candidate_pool} of {n_unlabeled} "
-                        f"unlabeled points (marginal-BALD ranking); raise "
-                        f"--candidate-pool to widen"
+                        "unlabeled points (marginal-BALD ranking); raise "
+                        "--candidate-pool to widen"
                     )
                 picked, _ = deep.batchbald_select(
                     probs,
@@ -616,7 +617,7 @@ def run_neural_experiment(
                 scores = _SCORES[strat](probs)
                 _, picked = select_top_k(scores, unlabeled, cfg.window_size)
             state = state_lib.reveal(state, picked)
-            jax.block_until_ready(state.labeled_mask)
+            jax.block_until_ready(state.labeled_mask)  # audit: ok[DAL101]
         score_time = dbg.records[-1][1]
         with dbg.phase("eval"):
             acc = learner.accuracy(net_state, test_x, test_y)

@@ -79,6 +79,7 @@ class PipelineStats:
     launch_seconds: float = 0.0     # dispatch -> stop-scalars-ready, summed
     touchdown_seconds: float = 0.0  # host bookkeeping wall, summed
     overlap_seconds: float = 0.0    # touchdown wall spent with a chunk in flight
+    vetoed: int = 0                 # speculative launches proven inactive a priori
 
     @property
     def touchdown_hidden_fraction(self) -> float:
@@ -143,10 +144,19 @@ class ChunkDriveControl:
             self.max_rounds is not None and self.max_rounds <= 0
         )
 
-    def may_dispatch(self, idx: int) -> bool:
+    def veto_reason(self, idx: int) -> Optional[str]:
+        """Why chunk ``idx`` would be vetoed (None = dispatchable). The
+        reason string rides the driver's ``launch_veto`` JSONL event, so the
+        auditor's runtime counterpart can assert veto counts per cause
+        instead of inferring them from missing launches."""
         if self.max_rounds is not None and idx * self.chunk_size >= self.max_rounds:
-            return False
-        return self.n_known + idx * self.chunk_size * self.window < self.label_cap
+            return "max_rounds_bound"
+        if self.n_known + idx * self.chunk_size * self.window >= self.label_cap:
+            return "label_cap_lattice"
+        return None
+
+    def may_dispatch(self, idx: int) -> bool:
+        return self.veto_reason(idx) is None
 
     def continue_after(self, n_labeled_after: int, n_active: int) -> bool:
         self.rounds_done += n_active
@@ -227,6 +237,7 @@ def run_pipelined(
     depth: int = 2,
     on_launch: Optional[Callable[..., None]] = None,
     may_dispatch: Optional[Callable[[int], bool]] = None,
+    on_veto: Optional[Callable[[int], None]] = None,
 ) -> tuple:
     """Drive chunk launches with up to ``depth`` in flight; returns
     ``(final_state, PipelineStats)``.
@@ -255,6 +266,13 @@ def run_pipelined(
       Must be monotone (once False, False forever). Stops the host can NOT
       predict (pool exhaustion short-reveals) still rely on speculation +
       masked no-ops, which stay bit-exact.
+    - ``on_veto(chunk_index)`` (optional) fires ONCE per vetoed index, at the
+      moment the veto first blocks a would-be dispatch — the structured
+      record of the speculative launch that never happened (drivers emit a
+      ``launch_veto`` JSONL event carrying ``ChunkDriveControl``'s reason).
+      Vetoes are also tallied in ``PipelineStats.vetoed``. A veto observed
+      after the stop decision is NOT recorded: nothing would have been
+      dispatched regardless, so counting it would overstate the vetoes.
 
     ``depth=1`` degenerates to the serial launch -> block -> touchdown order:
     no speculation, no overlap, bit-identical behavior AND ordering to the
@@ -267,12 +285,22 @@ def run_pipelined(
     stop = False
     next_index = 0
     last_ready = None  # when the previous chunk's scalars resolved
+    vetoed_seen = set()  # indices whose veto was already recorded
 
     def _can_dispatch():
-        return (
-            not stop
-            and (may_dispatch is None or may_dispatch(next_index))
-        )
+        if stop:
+            return False
+        if may_dispatch is None or may_dispatch(next_index):
+            return True
+        if next_index not in vetoed_seen:
+            # First observation of this index's veto: the fill loops re-probe
+            # the same index every iteration, but the skipped launch happened
+            # (didn't happen) exactly once.
+            vetoed_seen.add(next_index)
+            stats.vetoed += 1
+            if on_veto is not None:
+                on_veto(next_index)
+        return False
 
     def _dispatch_one():
         nonlocal state, next_index
@@ -286,8 +314,10 @@ def run_pipelined(
     while True:
         # Fill the launch window. The chunk beyond the oldest un-consumed one
         # is speculative (its predecessor's outcome is unknown) — masked
-        # no-op rounds make an overrun free and bit-exact.
-        while _can_dispatch() and len(inflight) < depth:
+        # no-op rounds make an overrun free and bit-exact. The capacity check
+        # runs FIRST: _can_dispatch records vetoes, and a veto only counts
+        # when a launch slot was actually open for the skipped dispatch.
+        while len(inflight) < depth and _can_dispatch():
             _dispatch_one()
         if not inflight:
             break
@@ -318,7 +348,7 @@ def run_pipelined(
         # the launch window has a free slot and chunk N+2 can dispatch now —
         # the device never waits out a long touchdown. depth=1 skips this
         # (the serial contract is touchdown-before-next-dispatch).
-        while depth > 1 and _can_dispatch() and len(inflight) < depth:
+        while depth > 1 and len(inflight) < depth and _can_dispatch():
             _dispatch_one()
         t_td = time.perf_counter()
         touchdown(

@@ -630,6 +630,7 @@ def run_sweep(
             depth=depth,
             on_launch=launches.record,
             may_dispatch=ctl.may_dispatch,
+            on_veto=lambda idx: launches.veto(idx, ctl.veto_reason(idx)),
         )
 
     if cfg.results_path:

@@ -533,7 +533,23 @@ class LaunchTracker:
         self.program = program
         self.fn = fn
         self.calls = 0
+        self.vetoes = 0
         self._last_cache = None
+
+    def veto(self, index: int, reason: Optional[str]) -> None:
+        """One vetoed speculative launch (runtime/pipeline.py ``on_veto``):
+        the driver PROVED chunk ``index`` would be wholly inactive and never
+        dispatched it. Emitted as a structured ``launch_veto`` event so veto
+        counts are assertable from the JSONL stream — previously a vetoed
+        launch was just silence."""
+        self.vetoes += 1
+        if self.writer is not None:
+            self.writer.event(
+                "launch_veto",
+                program=self.program,
+                index=index,
+                reason=reason or "unknown",
+            )
 
     def record(self, seconds: float, **extra) -> None:
         """One launch observation; ``extra`` (e.g. the pipelined driver's

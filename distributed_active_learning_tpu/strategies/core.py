@@ -15,7 +15,6 @@ from distributed_active_learning_tpu.ops import forest_eval, scoring, similarity
 from distributed_active_learning_tpu.runtime.state import PoolState
 from distributed_active_learning_tpu.strategies.base import (
     Strategy,
-    StrategyAux,
     register_strategy,
 )
 

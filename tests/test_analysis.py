@@ -1,0 +1,552 @@
+"""Static program auditor (analysis/): every rule proven live, real programs clean.
+
+Two halves, mirroring the acceptance contract:
+
+1. **Seeded violations** — each shipped rule (jaxpr AND lint) is exercised
+   by a fixture that deliberately violates it (a planted callback, a
+   non-donated carry, a weak-type leak, ...) and MUST produce a finding. A
+   rule nothing can fire is dead weight that rots into false confidence.
+2. **Clean programs** — representative entries of the real program registry
+   (the full strategy x kind x placement matrix runs in the CI ``analysis``
+   job) audit to zero findings, so the gate stays green on the code as it
+   actually is.
+"""
+
+import functools
+import warnings
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distributed_active_learning_tpu.analysis import (
+    AuditUnit,
+    audit_unit,
+    build_registry,
+    run_audit,
+)
+from distributed_active_learning_tpu.analysis import lint as lint_lib
+from distributed_active_learning_tpu.analysis.report import Finding, Report
+
+
+def _rules_fired(findings):
+    return {f.rule for f in findings}
+
+
+def _sds(shape, dtype):
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+# ---------------------------------------------------------------------------
+# seeded violations: jaxpr rules
+# ---------------------------------------------------------------------------
+
+
+def test_host_callback_rule_fires_on_planted_callback():
+    def spy(x):
+        pass
+
+    @jax.jit
+    def f(x):
+        jax.debug.callback(spy, x[0])
+        return x * 2
+
+    unit = AuditUnit(name="fixture/callback", fn=f, args=(_sds((4,), jnp.float32),))
+    fired = _rules_fired(audit_unit(unit))
+    assert "host-callback-in-fast-path" in fired
+
+    # the same program is LEGAL when the spec opted into streaming
+    ok = AuditUnit(
+        name="fixture/callback-ok", fn=f, args=(_sds((4,), jnp.float32),),
+        allows_callbacks=True,
+    )
+    assert "host-callback-in-fast-path" not in _rules_fired(audit_unit(ok))
+
+
+def test_host_callback_rule_fires_on_streaming_chunk_program():
+    """The REAL seeded violation: a chunk built with a stream callback is
+    exactly what the rule guards the default fast path against."""
+    from distributed_active_learning_tpu.analysis import programs as prog
+
+    unit = prog._build_chunk("uncertainty", "cpu")
+    from distributed_active_learning_tpu.runtime.loop import make_chunk_fn
+
+    streaming_fn = make_chunk_fn(
+        prog._strategy_and_aux("uncertainty")[0],
+        prog.WINDOW, prog.CHUNK_ROUNDS, prog._device_fit("gemm"),
+        prog.LABEL_CAP, with_metrics=True, n_classes=2,
+        stream_cb=lambda *a: None,
+    )
+    planted = AuditUnit(
+        name="fixture/streaming-chunk", fn=streaming_fn, args=unit.args,
+        expect_donation=True, with_metrics=True,
+        carry_in_argnums=(1,), carry_out_index=0,
+    )
+    findings = audit_unit(planted)
+    assert "host-callback-in-fast-path" in _rules_fired(findings)
+    # with the opt-in recorded, the same program audits clean
+    allowed = AuditUnit(
+        name="fixture/streaming-chunk-ok", fn=streaming_fn, args=unit.args,
+        allows_callbacks=True, expect_donation=True, with_metrics=True,
+        carry_in_argnums=(1,), carry_out_index=0,
+    )
+    assert not audit_unit(allowed)
+
+
+def test_device_transfer_rule_fires_on_concrete_device_put():
+    dev = jax.devices()[0]
+
+    @jax.jit
+    def f(x):
+        return jax.device_put(x, dev) + 1
+
+    unit = AuditUnit(name="fixture/device-put", fn=f, args=(_sds((4,), jnp.float32),))
+    assert "device-transfer-in-fast-path" in _rules_fired(audit_unit(unit))
+
+
+def test_f64_rule_fires_on_x64_leak():
+    @jax.jit
+    def f(x):
+        return x.astype(jnp.float64) * 2.0
+
+    with jax.experimental.enable_x64():
+        findings = audit_unit(
+            AuditUnit(name="fixture/f64", fn=f, args=(_sds((4,), jnp.float32),))
+        )
+    assert "f64-aval" in _rules_fired(findings)
+
+
+def test_weak_type_rule_fires_on_promoted_output():
+    @jax.jit
+    def f(x):
+        return x + 1.0  # int32 + python float -> weakly-typed f32
+
+    unit = AuditUnit(name="fixture/weak", fn=f, args=(_sds((4,), jnp.int32),))
+    assert "weak-type-output" in _rules_fired(audit_unit(unit))
+
+
+def test_carry_drift_rule_fires_on_dtype_change():
+    @jax.jit
+    def f(state, x):
+        # the "carry" comes back at a different dtype: the next launch,
+        # threading out[0] into arg 0, would retrigger compilation
+        return state.astype(jnp.float32) + x, x
+
+    unit = AuditUnit(
+        name="fixture/carry-drift", fn=f,
+        args=(_sds((4,), jnp.int32), _sds((4,), jnp.float32)),
+        carry_in_argnums=(0,), carry_out_index=0,
+    )
+    assert "carry-aval-drift" in _rules_fired(audit_unit(unit))
+
+
+def test_donation_rule_fires_on_undonated_carry():
+    """The ISSUE's canonical seed: a chunk-shaped program whose builder
+    FORGOT donate_argnums while the spec still promises donation."""
+
+    @jax.jit  # no donate_argnums
+    def f(state, x):
+        return state + x, jnp.sum(x)
+
+    unit = AuditUnit(
+        name="fixture/no-donation", fn=f,
+        args=(_sds((8,), jnp.float32), _sds((8,), jnp.float32)),
+        expect_donation=True,
+    )
+    assert "donation-not-aliased" in _rules_fired(audit_unit(unit))
+
+
+def test_donation_rule_fires_on_unusable_donation():
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def f(x):
+        return jnp.sum(x)  # scalar output: the [8] donation cannot alias
+
+    unit = AuditUnit(
+        name="fixture/unusable-donation", fn=f,
+        args=(_sds((8,), jnp.float32),), expect_donation=True,
+    )
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")  # jax's donated-buffers warning
+        findings = audit_unit(unit)
+    assert "donation-not-aliased" in _rules_fired(findings)
+
+
+def test_donation_rule_passes_on_real_donated_chunk():
+    from distributed_active_learning_tpu.analysis import programs as prog
+
+    unit = prog._build_chunk("random", "cpu")
+    assert "donation-not-aliased" not in _rules_fired(audit_unit(unit))
+
+
+def test_collective_rule_fires_on_all_gather_in_shard_map(devices):
+    from jax.sharding import PartitionSpec as P
+
+    from distributed_active_learning_tpu.parallel import make_mesh
+    from distributed_active_learning_tpu.utils.compat import shard_map
+
+    mesh = make_mesh(data=4, model=2)
+
+    @jax.jit
+    def f(x):
+        def body(block):
+            # rematerializes the sharded rows on every shard
+            return jax.lax.all_gather(block, "data", axis=0, tiled=True)
+
+        return shard_map(
+            body, mesh=mesh, in_specs=P("data"), out_specs=P(None),
+            check_vma=False,
+        )(x)
+
+    unit = AuditUnit(name="fixture/all-gather", fn=f, args=(_sds((8,), jnp.float32),))
+    assert "collective-in-shard-map" in _rules_fired(audit_unit(unit))
+
+    @jax.jit
+    def g(x):
+        def body(block):
+            return jax.lax.psum(block, "data")  # sanctioned reduction
+
+        return shard_map(
+            body, mesh=mesh, in_specs=P("data"), out_specs=P(None),
+            check_vma=False,
+        )(x)
+
+    ok = AuditUnit(name="fixture/psum", fn=g, args=(_sds((8,), jnp.float32),))
+    assert "collective-in-shard-map" not in _rules_fired(audit_unit(ok))
+
+
+def test_metrics_rule_fires_when_round_metrics_dropped():
+    @jax.jit
+    def f(x):
+        return x * 2, jnp.sum(x)  # promised metrics, returns none
+
+    unit = AuditUnit(
+        name="fixture/no-metrics", fn=f, args=(_sds((4,), jnp.float32),),
+        with_metrics=True,
+    )
+    assert "metrics-missing" in _rules_fired(audit_unit(unit))
+
+
+def test_trace_failure_is_an_error_finding():
+    @jax.jit
+    def f(x):
+        raise RuntimeError("builder bug")
+
+    unit = AuditUnit(name="fixture/broken", fn=f, args=(_sds((4,), jnp.float32),))
+    findings = audit_unit(unit)
+    assert [f_.rule for f_ in findings] == ["trace-failure"]
+    assert findings[0].severity == "error"
+
+
+# ---------------------------------------------------------------------------
+# seeded violations: lint rules
+# ---------------------------------------------------------------------------
+
+
+def _lint_source(tmp_path, source):
+    p = tmp_path / "fixture_mod.py"
+    p.write_text(source)
+    return lint_lib.lint_file(str(p), "fixture_mod.py")
+
+
+def test_lint_block_until_ready(tmp_path):
+    findings = _lint_source(
+        tmp_path,
+        "def f(x):\n"
+        "    y = g(x)\n"
+        "    y.block_until_ready()\n"
+        "    return y\n",
+    )
+    assert _rules_fired(findings) == {"DAL101"}
+    # the inline waiver silences exactly this rule
+    waived = _lint_source(
+        tmp_path,
+        "def f(x):\n"
+        "    y = g(x)\n"
+        "    y.block_until_ready()  # audit: ok[DAL101]\n"
+        "    return y\n",
+    )
+    assert not waived
+
+
+def test_lint_host_cast_in_jit(tmp_path):
+    findings = _lint_source(
+        tmp_path,
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return float(x) * 2\n",
+    )
+    assert "DAL102" in _rules_fired(findings)
+    # the same cast OUTSIDE a jitted scope is host code and legal
+    clean = _lint_source(
+        tmp_path,
+        "def f(x):\n"
+        "    return float(x) * 2\n",
+    )
+    assert "DAL102" not in _rules_fired(clean)
+
+
+def test_lint_host_cast_in_nested_jit_body(tmp_path):
+    findings = _lint_source(
+        tmp_path,
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    def body(c, _):\n"
+        "        return c + int(x), None\n"
+        "    return jax.lax.scan(body, x, None, length=3)\n",
+    )
+    assert "DAL102" in _rules_fired(findings)
+
+
+def test_lint_mutable_closure(tmp_path):
+    findings = _lint_source(
+        tmp_path,
+        "import jax\n"
+        "def make(n):\n"
+        "    scale = 1.0\n"
+        "    scale = scale * n\n"
+        "    @jax.jit\n"
+        "    def f(x):\n"
+        "        return x * scale\n"
+        "    return f\n",
+    )
+    assert "DAL103" in _rules_fired(findings)
+    # a closed-over name bound ONCE is the normal factory pattern
+    clean = _lint_source(
+        tmp_path,
+        "import jax\n"
+        "def make(scale):\n"
+        "    @jax.jit\n"
+        "    def f(x):\n"
+        "        return x * scale\n"
+        "    return f\n",
+    )
+    assert "DAL103" not in _rules_fired(clean)
+
+
+def test_lint_waiver_works_on_any_line_of_a_multiline_call(tmp_path):
+    findings = _lint_source(
+        tmp_path,
+        "import jax\n"
+        "def f(tree):\n"
+        "    jax.block_until_ready(\n"
+        "        tree,\n"
+        "    )  # audit: ok[DAL101]\n",
+    )
+    assert not findings
+
+
+def test_lint_dal103_waiver_on_def_or_decorator_line_only(tmp_path):
+    waived = _lint_source(
+        tmp_path,
+        "import jax\n"
+        "def make(n):\n"
+        "    scale = 1.0\n"
+        "    scale = scale * n\n"
+        "    @jax.jit\n"
+        "    def f(x):  # audit: ok[DAL103]\n"
+        "        return x * scale\n"
+        "    return f\n",
+    )
+    assert "DAL103" not in _rules_fired(waived)
+    # a waiver buried in the BODY must not blanket the function finding
+    body_waiver = _lint_source(
+        tmp_path,
+        "import jax\n"
+        "def make(n):\n"
+        "    scale = 1.0\n"
+        "    scale = scale * n\n"
+        "    @jax.jit\n"
+        "    def f(x):\n"
+        "        return x * scale  # audit: ok[DAL103]\n"
+        "    return f\n",
+    )
+    assert "DAL103" in _rules_fired(body_waiver)
+
+
+def test_lint_dict_ordered_static(tmp_path):
+    findings = _lint_source(
+        tmp_path,
+        "def key_for(options):\n"
+        "    return tuple(options.items())\n",
+    )
+    assert "DAL104" in _rules_fired(findings)
+    clean = _lint_source(
+        tmp_path,
+        "def key_for(options):\n"
+        "    return tuple(sorted(options.items()))\n",
+    )
+    assert "DAL104" not in _rules_fired(clean)
+
+
+def test_lint_real_driver_surfaces_are_clean():
+    findings = lint_lib.lint_paths(lint_lib.default_lint_targets())
+    assert findings == [], [str(f) for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# clean programs: representative registry entries audit to zero findings
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "kind,strategy,placement",
+    [
+        ("chunk", "uncertainty", "cpu"),
+        ("sweep", "entropy", "cpu"),
+        ("neural_chunk", "bald", "cpu"),
+    ],
+)
+def test_representative_programs_audit_clean(kind, strategy, placement):
+    specs = build_registry(
+        strategies=[strategy], kinds=[kind], placements=[placement]
+    )
+    assert len(specs) == 1
+    report = run_audit(specs)
+    assert report.programs == [specs[0].name]
+    assert report.findings == [], [str(f) for f in report.findings]
+
+
+def test_mesh_chunk_audits_clean(devices):
+    report = run_audit(
+        build_registry(
+            strategies=["uncertainty"], kinds=["chunk"], placements=["mesh4x2"]
+        )
+    )
+    assert report.programs == ["chunk/uncertainty/mesh4x2"]
+    assert report.findings == [], [str(f) for f in report.findings]
+
+
+@pytest.mark.slow  # the full matrix (~39 traced programs, ~40s) runs in CI
+def test_full_registry_audits_clean():
+    report = run_audit(build_registry())
+    assert len(report.programs) >= 30
+    assert report.findings == [], [str(f) for f in report.findings]
+
+
+def test_registry_covers_every_strategy_and_kind():
+    from distributed_active_learning_tpu.runtime.neural_loop import (
+        FUSABLE_STRATEGIES,
+    )
+    from distributed_active_learning_tpu.strategies import available_strategies
+
+    names = {s.name for s in build_registry()}
+    for strat in available_strategies():
+        for kind in ("chunk", "sweep"):
+            for placement in ("cpu", "mesh4x2"):
+                assert f"{kind}/{strat}/{placement}" in names
+    for strat in FUSABLE_STRATEGIES:
+        assert f"neural_chunk/{strat}/cpu" in names
+
+
+def test_specs_for_experiment_audits_the_configured_mesh_shape(devices):
+    """run.py --audit must trace the mesh shape the config launches, not the
+    registry's fixed 4x2 stand-in (a 2x1 program has different collective/
+    sharding structure). Inexpressible model widths fall back to 4x2."""
+    import dataclasses
+
+    from distributed_active_learning_tpu.analysis import specs_for_experiment
+    from distributed_active_learning_tpu.config import (
+        ExperimentConfig,
+        ForestConfig,
+        MeshConfig,
+        StrategyConfig,
+    )
+
+    cfg = ExperimentConfig(
+        forest=ForestConfig(fit="device"),
+        strategy=StrategyConfig(name="uncertainty"),
+        mesh=MeshConfig(data=2, model=1),
+    )
+    specs = specs_for_experiment(cfg)
+    assert [s.name for s in specs] == ["chunk/uncertainty/mesh2x1"]
+    report = run_audit(specs)
+    assert report.programs == ["chunk/uncertainty/mesh2x1"]
+    assert report.findings == [], [str(f) for f in report.findings]
+
+    # model width that doesn't divide the audit's tree count -> 4x2 stand-in
+    odd = dataclasses.replace(cfg, mesh=MeshConfig(data=1, model=3))
+    assert [s.name for s in specs_for_experiment(odd)] == [
+        "chunk/uncertainty/mesh4x2"
+    ]
+
+    # sweep_seeds routes to the sweep program at the same shape
+    swept = dataclasses.replace(cfg, sweep_seeds=3)
+    assert [s.name for s in specs_for_experiment(swept)] == [
+        "sweep/uncertainty/mesh2x1"
+    ]
+
+
+def test_mesh_programs_skip_cleanly_without_devices(monkeypatch):
+    from distributed_active_learning_tpu.analysis import programs as prog
+
+    monkeypatch.setattr(
+        prog.jax, "devices", lambda *a, **k: [object()]  # 1 "device"
+    )
+    report = run_audit(
+        build_registry(strategies=["random"], kinds=["chunk"])
+    )
+    assert report.programs == ["chunk/random/cpu"]
+    assert "chunk/random/mesh4x2" in report.skipped
+    assert "devices" in report.skipped["chunk/random/mesh4x2"]
+
+
+# ---------------------------------------------------------------------------
+# report layer + CLI
+# ---------------------------------------------------------------------------
+
+
+def _mk(rule, severity):
+    return Finding(rule=rule, severity=severity, program="p", location="l", message="m")
+
+
+def test_report_gating_and_json_schema():
+    import json
+
+    report = Report(
+        findings=[_mk("a", "warn"), _mk("b", "error"), _mk("c", "info")],
+        programs=["p1", "p2"],
+    )
+    assert report.max_severity == "error"
+    assert report.counts() == {"info": 1, "warn": 1, "error": 1}
+    assert report.gate("error") and report.gate("warn") and report.gate("info")
+    clean = Report(programs=["p"])
+    assert not clean.gate("info") and clean.max_severity is None
+
+    payload = json.loads(report.to_json())
+    assert payload["schema"] == 1
+    assert payload["programs_audited"] == ["p1", "p2"]
+    assert payload["max_severity"] == "error"
+    assert len(payload["findings"]) == 3
+    assert set(payload["findings"][0]) == {
+        "rule", "severity", "program", "location", "message"
+    }
+    # the human table renders the same records
+    table = report.render_table()
+    assert "error" in table and "p1" not in table  # programs only in header
+
+
+def test_cli_json_and_exit_codes(capsys):
+    import json
+
+    from distributed_active_learning_tpu.analysis.__main__ import main
+
+    rc = main([
+        "--json", "--kinds", "chunk", "--strategies", "random",
+        "--placements", "cpu",
+    ])
+    out = capsys.readouterr().out
+    payload = json.loads(out)
+    assert rc == 0
+    assert payload["programs_audited"] == ["chunk/random/cpu"]
+    assert payload["findings"] == []
+
+    # --rules prints the live registry (the README rule table's source)
+    assert main(["--rules"]) == 0
+    out = capsys.readouterr().out
+    assert "host-callback-in-fast-path" in out and "DAL104" in out
+
+    assert main(["--list", "--kinds", "sweep"]) == 0
+    out = capsys.readouterr().out
+    assert "sweep/uncertainty/cpu" in out

@@ -98,6 +98,42 @@ def test_bench_neural_tiny_pool_keeps_candidates(bench):
     assert r["transformer_batchbald_round_seconds"] > 0
 
 
+def test_bench_audit_gate_contract(bench, monkeypatch):
+    """--audit's gate: a clean registry yields the JSON summary dict; an
+    error-severity finding raises (main's except path then still prints the
+    one JSON line, carrying the audit error). Registry narrowed to one
+    program so the test costs one trace, not the full matrix."""
+    from distributed_active_learning_tpu import analysis
+
+    full = analysis.build_registry
+    monkeypatch.setattr(
+        analysis, "build_registry",
+        lambda **kw: full(
+            strategies=["random"], kinds=["chunk"], placements=["cpu"]
+        ),
+    )
+    summary = bench._audit_gate()
+    assert summary["programs_audited"] == 1
+    assert summary["max_severity"] is None
+    assert summary["counts"] == {"info": 0, "warn": 0, "error": 0}
+
+    # seeded failure: a registry whose one spec cannot build is an error
+    from distributed_active_learning_tpu.analysis.programs import ProgramSpec
+
+    def _boom():
+        raise RuntimeError("seeded build failure")
+
+    monkeypatch.setattr(
+        analysis, "build_registry",
+        lambda **kw: [ProgramSpec(
+            name="chunk/broken/cpu", kind="chunk", strategy="broken",
+            placement="cpu", build=_boom,
+        )],
+    )
+    with pytest.raises(RuntimeError, match="audit failed"):
+        bench._audit_gate()
+
+
 def test_trace_parser_folds_named_scopes(bench, tmp_path):
     """device_seconds_by_phase: a chrome-trace capture's complete events fold
     onto the jax.named_scope phase names (innermost scope wins, so nested
@@ -138,6 +174,69 @@ def test_trace_parser_folds_named_scopes(bench, tmp_path):
     }
     # empty dirs parse to {} (profiling off / CPU captures without op lanes)
     assert bench._trace_phases(str(tmp_path / "empty")) == {}
+
+
+def test_trace_parser_survives_malformed_captures(bench, tmp_path):
+    """A profile dir holding truncated/garbage/half-written trace files must
+    degrade to {} (or the parseable subset), never raise: the bench folds
+    this into its one JSON line, and a crashed parse would cost the whole
+    artifact (the BENCH_r05 lesson, applied to --profile-dir)."""
+    import gzip
+    import json
+    import os
+
+    d = str(tmp_path)
+    # empty file
+    open(os.path.join(d, "empty.trace.json"), "w").close()
+    # garbage that is not JSON
+    with open(os.path.join(d, "garbage.trace.json"), "w") as f:
+        f.write("not json {{{")
+    # .gz extension with non-gzip bytes
+    with open(os.path.join(d, "fake.trace.json.gz"), "wb") as f:
+        f.write(b"plain bytes, no gzip magic")
+    # valid JSON of the wrong shape (traceEvents is a dict, events malformed)
+    with open(os.path.join(d, "shape.trace.json"), "w") as f:
+        json.dump({"traceEvents": {"oops": 1}}, f)
+    with open(os.path.join(d, "rows.trace.json"), "w") as f:
+        json.dump({"traceEvents": [
+            "not-an-event",
+            {"ph": "X", "name": "al/score/fusion.1"},            # no dur
+            {"ph": "X", "name": "al/score/fusion.2", "dur": "3"},  # dur not a number
+        ]}, f)
+    assert bench._trace_phases(d) == {}
+
+    # one good file among the wreckage still parses
+    with gzip.open(os.path.join(d, "good.trace.json.gz"), "wt") as f:
+        json.dump({"traceEvents": [
+            {"ph": "X", "name": "jit(f)/al/score/fusion.1", "dur": 1000},
+        ]}, f)
+    assert bench._trace_phases(d) == {"al/score": 0.001}
+
+
+def test_trace_parser_nested_identical_scopes_count_once(bench, tmp_path):
+    """A name stack that re-enters the SAME scope ('al/score/.../al/score/op')
+    must charge the op's duration once, to the innermost occurrence — not
+    once per occurrence (re-entered scopes are real: a strategy's score fn
+    calling a helper that opens the same named_scope)."""
+    import gzip
+    import json
+    import os
+
+    events = [
+        # scope re-entered within one stack: one op, one charge
+        {"ph": "X", "name": "jit(f)/al/score/helper/al/score/fusion.1",
+         "dur": 1000},
+        # same scope twice with an op BETWEEN the occurrences: path continues
+        # past the innermost match, so it is an op row, charged once
+        {"ph": "X", "name": "al/score/al/score/dot.2", "dur": 500},
+        # path ENDING at the re-entered scope is an aggregation span: skipped
+        {"ph": "X", "name": "jit(f)/al/score/helper/al/score", "dur": 9999},
+    ]
+    run_dir = os.path.join(tmp_path, "plugins", "profile", "run")
+    os.makedirs(run_dir)
+    with gzip.open(os.path.join(run_dir, "host.trace.json.gz"), "wt") as f:
+        json.dump({"traceEvents": events}, f)
+    assert bench._trace_phases(str(tmp_path)) == {"al/score": 0.0015}
 
 
 @pytest.mark.slow  # two serial run_experiment compiles + one sweep compile

@@ -128,6 +128,29 @@ def test_cli_device_fit(capsys):
     assert all(0.0 <= r["accuracy"] <= 1.0 for r in lines)
 
 
+def test_cli_audit_gates_then_runs(capsys):
+    """--audit traces the exact fused program the config would launch (one
+    chunk program for this strategy/placement) before the experiment, and a
+    clean audit lets the run proceed."""
+    rc = main([
+        "--dataset", "checkerboard2x2", "--strategy", "uncertainty",
+        "--window", "25", "--rounds", "2", "--rounds-per-launch", "2",
+        "--json", "--fit", "device", "--trees", "6", "--depth", "4",
+        "--audit",
+    ])
+    assert rc == 0
+    captured = capsys.readouterr()
+    assert "# audit clean: chunk/uncertainty/cpu" in captured.err
+    # non-quiet so the audit banner prints; Debugger iteration logs share
+    # stdout with the records, so parse only the JSON lines
+    lines = [
+        json.loads(l)
+        for l in captured.out.strip().splitlines()
+        if l.startswith("{")
+    ]
+    assert len(lines) == 2
+
+
 def test_cli_half_checkpoint_request_rejected():
     """--checkpoint-dir without --checkpoint-every (or vice versa) would be
     silently ignored by both loops — refuse it instead."""

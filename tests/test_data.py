@@ -3,7 +3,6 @@
 import numpy as np
 import jax
 import jax.numpy as jnp
-import pytest
 
 from distributed_active_learning_tpu.data import (
     load_labeled_text,

@@ -15,7 +15,6 @@ from distributed_active_learning_tpu.models.forest import (
     forest_accuracy,
 )
 from distributed_active_learning_tpu.ops.trees import (
-    PackedForest,
     predict_leaves,
     predict_proba,
     predict_votes,

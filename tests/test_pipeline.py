@@ -145,6 +145,51 @@ def test_may_dispatch_veto_skips_provably_inactive_chunks():
     assert stats.chunks == 3  # no speculative 4th launch
 
 
+def test_veto_is_reported_once_with_its_index():
+    """A vetoed speculative launch is no longer silent: on_veto fires exactly
+    once per vetoed index (the fill loop re-probes every iteration) and
+    PipelineStats tallies it — the runtime counterpart the auditor's JSONL
+    assertions key on."""
+    vetoed = []
+    done = {"rounds": 0}
+    k, total = 3, 9
+
+    def continue_after(nla, n_active):
+        done["rounds"] += n_active
+        return n_active == k and done["rounds"] < total
+
+    final, stats = run_pipelined(
+        0,
+        dispatch=lambda state, idx: _fake_chunk(state, min(total - state, k)),
+        touchdown=lambda *a: None,
+        continue_after=continue_after,
+        depth=2,
+        may_dispatch=lambda idx: idx * k < total,
+        on_veto=vetoed.append,
+    )
+    assert vetoed == [3]  # the one speculative chunk the bound disproved
+    assert stats.vetoed == 1 and stats.chunks == 3 and final == total
+
+
+def test_veto_after_stop_is_not_recorded():
+    """Once continue_after stopped the drive, nothing would dispatch anyway —
+    a veto observed then must not inflate the count."""
+    vetoed = []
+    k, total = 3, 6
+
+    final, stats = run_pipelined(
+        0,
+        dispatch=lambda state, idx: _fake_chunk(state, min(total - state, k)),
+        touchdown=lambda *a: None,
+        # stop on the second chunk's scalars (rounds quota spent)
+        continue_after=lambda nla, n_active: nla < total,
+        depth=1,  # no speculation: the stop lands before any veto probe
+        may_dispatch=lambda idx: idx < 2,
+        on_veto=vetoed.append,
+    )
+    assert final == total and vetoed == [] and stats.vetoed == 0
+
+
 def test_overlap_accounting_counts_inflight_touchdowns():
     """With depth 2 every touchdown except the drain-phase last one runs with
     a chunk in flight, so the hidden fraction lands strictly between 0 and 1
@@ -192,6 +237,29 @@ def _assert_records_equal(a, b):
 # that suite cannot: the explicit depth-1 (serial-order) arm and depth >
 # chunk-count, both pinned against the SAME shared per-round baseline, which
 # transitively pins depth 1 == depth 2 bit-for-bit.
+
+
+def test_vetoed_launch_emits_structured_jsonl_reason(tmp_path):
+    """End-to-end veto accounting: with max_rounds == rounds_per_launch the
+    depth-2 driver can PROVE the speculative second chunk is inactive; the
+    JSONL stream must carry one launch_veto event naming the bound (before
+    this, a vetoed launch left no trace at all)."""
+    import json
+
+    from distributed_active_learning_tpu.runtime.telemetry import MetricsWriter
+
+    path = str(tmp_path / "m.jsonl")
+    with MetricsWriter(path) as writer:
+        run_experiment(_forest_cfg(6, 2, max_rounds=6), metrics=writer)
+    events = [json.loads(l) for l in open(path) if l.strip()]
+    vetoes = [e for e in events if e["kind"] == "launch_veto"]
+    assert len(vetoes) == 1
+    assert vetoes[0]["program"] == "chunk_scan"
+    assert vetoes[0]["index"] == 1
+    assert vetoes[0]["reason"] == "max_rounds_bound"
+    # exactly one real launch: the veto spared the speculative no-op chunk
+    launches = [e for e in events if e["kind"] == "launch"]
+    assert len(launches) == 1
 
 
 def test_forest_serial_depth1_and_deep_depth_match_per_round(forest_device_base):

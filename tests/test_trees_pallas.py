@@ -7,9 +7,7 @@ exact and all three kernels must agree bit-for-bit.
 """
 
 import numpy as np
-import pytest
 
-import jax
 import jax.numpy as jnp
 
 from distributed_active_learning_tpu.config import ForestConfig
