@@ -774,6 +774,125 @@ def bench_sweep(args):
     }
 
 
+def bench_serve(args):
+    """Streaming-service benchmark (the serving/ tentpole): sustained
+    queries/sec and p50/p99 scoring latency under CONCURRENT ingest.
+
+    Drives the production :class:`~serving.service.ALService` with the CLI's
+    traffic shape — score queries interleaved with ingest blocks — over a
+    stream whose second half is distribution-shifted, so the drift monitor's
+    entropy trigger fires for real (plus the staleness backstop). Warmup
+    (first score, first ingest, one forced re-fit chunk) compiles each
+    program instance once and is reported separately;
+    ``recompiles_after_warmup`` must stay 0 — the slab watermark design's
+    no-silent-recompile contract. Slab growths and their one-compile-per-new-
+    capacity cost happen INSIDE the timed window, as they would in
+    production.
+    """
+    import jax  # noqa: F401  (backend must be up before building programs)
+
+    from distributed_active_learning_tpu.config import (
+        ExperimentConfig,
+        ForestConfig,
+        ServeConfig,
+        StrategyConfig,
+    )
+    from distributed_active_learning_tpu.serving.service import ALService
+
+    rng = np.random.default_rng(0)
+    d = args.features
+    n0 = args.serve_pool
+    queries = args.serve_queries
+
+    def make(n, shift=0.0):
+        x = rng.normal(size=(n, d)).astype(np.float32) + shift
+        y = (x[:, 0] + 0.3 * x[:, 1] > shift).astype(np.int32)
+        return x, y
+
+    x0, y0 = make(n0)
+    test_x, test_y = make(min(n0, 1024))
+    window = min(args.window, 20)
+    serve = ServeConfig(
+        slab_rows=1024,
+        ingest_block=64,
+        score_width=64,
+        refit_rounds=4,
+        drift_entropy_shift=0.15,
+        drift_min_fresh=64,
+        max_staleness=100,
+    )
+    cfg = ExperimentConfig(
+        forest=ForestConfig(
+            n_trees=args.trees, max_depth=4, kernel=args.kernel, fit="device",
+            fit_budget=serve.slab_rows,
+        ),
+        strategy=StrategyConfig(name="uncertainty", window_size=window),
+        n_start=min(20, max(n0 // 8, 4)),
+        log_every=0,
+    )
+    service = ALService(cfg, serve, x0, y0, test_x, test_y)
+
+    # The arrival stream: every ingest_every-th query submits one block. Both
+    # the stream AND the query traffic shift distribution in the second half,
+    # so the drift monitor's entropy trigger fires for real (the monitor
+    # watches SERVED batches against the last chunk's pool-entropy baseline).
+    ingest_every = 4
+    n_stream = (queries // ingest_every + 1) * serve.ingest_block
+    sx1, sy1 = make(n_stream // 2)
+    sx2, sy2 = make(n_stream - n_stream // 2, shift=2.5)
+    stream_x = np.concatenate([sx1, sx2])
+    stream_y = np.concatenate([sy1, sy2])
+    test_shift_x, _ = make(min(n0, 1024), shift=2.5)
+
+    # Warmup: compile the endpoint, the ingest program, and one re-fit chunk
+    # at the initial capacity (first calls are warmup by definition; growth
+    # capacities compile inside the timed loop, as in production).
+    t0 = time.perf_counter()
+    service.score(test_x[: serve.score_width])
+    service.submit(stream_x[: serve.ingest_block], stream_y[: serve.ingest_block])
+    service.refit_now("warmup")
+    service.flush()
+    warmup_sec = time.perf_counter() - t0
+
+    stream_pos = serve.ingest_block
+    latencies = []
+    t0 = time.perf_counter()
+    for i in range(queries):
+        if i % ingest_every == 0 and stream_pos < stream_x.shape[0]:
+            hi = stream_pos + serve.ingest_block
+            service.submit(stream_x[stream_pos:hi], stream_y[stream_pos:hi])
+            stream_pos = hi
+        src = test_x if i < queries // 2 else test_shift_x
+        idx = rng.integers(0, src.shape[0], size=serve.score_width)
+        tq = time.perf_counter()
+        service.score(src[idx])
+        latencies.append(time.perf_counter() - tq)
+    service.flush()
+    wall = time.perf_counter() - t0
+
+    lat = np.asarray(latencies)
+    summary = service.summary()
+    return {
+        "serve_qps": round(queries / wall, 2),
+        "serve_queries": queries,
+        "serve_p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 3),
+        "serve_p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 3),
+        "serve_scores_per_sec": round(queries * serve.score_width / wall, 1),
+        "ingest_points_per_sec": round(
+            (summary["ingested_points"] - serve.ingest_block) / wall, 1
+        ),
+        "serve_warmup_seconds": round(warmup_sec, 3),
+        "recompiles_after_warmup": summary["recompiles_after_warmup"],
+        "refits": summary["refits"],
+        "refit_rounds": summary["refit_rounds"],
+        "refit_reasons": summary["refit_reasons"],
+        "slab_growths": summary["slab_growths"],
+        "slab_capacity": summary["capacity"],
+        "pool_fill": summary["fill"],
+        "pool_labeled": summary["labeled"],
+    }
+
+
 def bench_lal(args):
     """One LAL query at reference scale: 50-tree base forest, 2000-tree
     regressor, 1000-point pool (``classes/RESULTS.txt``)."""
@@ -1014,6 +1133,21 @@ def _run_mode(args) -> dict:
             # diffs key on sweep_experiments_rounds_per_second by name)
             **r,
         }
+    if args.mode == "serve":
+        r = bench_serve(args)
+        return {
+            "metric": "serve_qps",
+            "value": r["serve_qps"],
+            "unit": (
+                f"score queries/s ({r['serve_queries']} queries under "
+                "concurrent ingest, resident-forest endpoint, "
+                "drift-triggered re-fits)"
+            ),
+            "vs_baseline": None,
+            # the full key set rides too: the CI serve-smoke job asserts
+            # serve_qps/recompiles_after_warmup by name (like sweep mode)
+            **r,
+        }
     if args.mode == "round":
         r = bench_round(args)
         return {
@@ -1047,8 +1181,8 @@ def _run_mode(args) -> dict:
     # neural compile start at deadline-minus-epsilon and blow the outer
     # timeout anyway. On TPU the modes run in seconds, so no pre-estimates.
     _cpu_cost = {
-        "score": 30, "density": 25, "round": 220, "sweep": 90, "lal": 30,
-        "neural": 260,
+        "score": 30, "density": 25, "round": 220, "sweep": 90, "serve": 120,
+        "lal": 30, "neural": 260,
     }
 
     def want(name):
@@ -1121,6 +1255,9 @@ def _run_mode(args) -> dict:
     if want("sweep"):
         sw = bench_sweep(args)
         out.update(sw)
+    if want("serve"):
+        sv = bench_serve(args)
+        out.update(sv)
     if want("lal"):
         ll = bench_lal(args)
         out.update({
@@ -1211,6 +1348,8 @@ _TPU_SIZES = dict(
     rounds_per_launch=8,
     sweep_experiments=8,
     sweep_pool=100_000,
+    serve_queries=2000,
+    serve_pool=8192,
 )
 _CPU_SIZES = dict(
     pool=10_000,
@@ -1224,6 +1363,8 @@ _CPU_SIZES = dict(
     rounds_per_launch=4,
     sweep_experiments=8,
     sweep_pool=500,
+    serve_queries=220,
+    serve_pool=256,
 )
 
 
@@ -1295,7 +1436,10 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument(
         "--mode",
-        choices=["all", "score", "density", "round", "sweep", "lal", "neural"],
+        choices=[
+            "all", "score", "density", "round", "sweep", "serve", "lal",
+            "neural",
+        ],
         default="all",
     )
     # Size flags default to None = backend-resolved (_resolve_sizes): the
@@ -1320,6 +1464,16 @@ def main():
     ap.add_argument(
         "--sweep-pool", type=int, default=None,
         help="sweep mode: shared pool rows (backend-resolved default)",
+    )
+    ap.add_argument(
+        "--serve-queries", type=int, default=None,
+        help="serve mode: score queries driven under concurrent ingest "
+        "(backend-resolved default; acceptance floor is 200 on CPU smoke)",
+    )
+    ap.add_argument(
+        "--serve-pool", type=int, default=None,
+        help="serve mode: cold-start pool rows seeding the slab-paged "
+        "service (backend-resolved default)",
     )
     ap.add_argument(
         "--profile-dir", default=None, metavar="DIR",

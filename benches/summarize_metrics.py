@@ -229,6 +229,74 @@ def summarize(events: List[dict]) -> str:
             )
         )
 
+    # Streaming-service sections (serving/service.py): per-query scoring
+    # latency percentiles and ingest throughput. Defensive like the trace
+    # parser above — a malformed event (missing/non-numeric fields) is
+    # skipped, never a crash: these streams come from long-running services
+    # whose tails may be torn mid-line rewrites.
+    serve_secs = sorted(
+        float(e["seconds"])
+        for e in events
+        if e.get("kind") == "serve_latency"
+        and isinstance(e.get("seconds"), (int, float))
+        and not isinstance(e.get("seconds"), bool)
+    )
+    if serve_secs:
+        def _pct(q: float) -> str:
+            i = min(int(q * len(serve_secs)), len(serve_secs) - 1)
+            return f"{serve_secs[i] * 1e3:.3f}"
+
+        ts = [
+            e["ts"] for e in events
+            if e.get("kind") == "serve_latency"
+            and isinstance(e.get("ts"), (int, float))
+        ]
+        span = (max(ts) - min(ts)) if len(ts) > 1 else 0.0
+        qps = f"{len(serve_secs) / span:.2f}" if span > 0 else "-"
+        out.append(
+            "\n== serve latency ==\n"
+            + _table(
+                ["queries", "p50 ms", "p90 ms", "p99 ms", "max ms", "qps"],
+                [[
+                    len(serve_secs), _pct(0.50), _pct(0.90), _pct(0.99),
+                    f"{serve_secs[-1] * 1e3:.3f}", qps,
+                ]],
+            )
+        )
+
+    ingests = [
+        e for e in events
+        if e.get("kind") == "ingest"
+        and isinstance(e.get("points"), int)
+        and not isinstance(e.get("points"), bool)
+    ]
+    if ingests:
+        total = sum(e["points"] for e in ingests)
+        ts = [e["ts"] for e in ingests if isinstance(e.get("ts"), (int, float))]
+        span = (max(ts) - min(ts)) if len(ts) > 1 else 0.0
+        rate = f"{total / span:.1f}" if span > 0 else "-"
+        last = ingests[-1]
+        out.append(
+            "\n== ingest ==\n"
+            + _table(
+                ["blocks", "points", "points/s", "fill", "capacity"],
+                [[
+                    len(ingests), total, rate,
+                    last.get("fill", "-"), last.get("capacity", "-"),
+                ]],
+            )
+        )
+
+    refits = [e for e in events if e.get("kind") == "refit"]
+    if refits:
+        by_reason = Counter(str(e.get("reason", "?")) for e in refits)
+        out.append(
+            "\n== refits ==\n"
+            + f"{len(refits)} drift-dispatched chunk launches ("
+            + ", ".join(f"{r}={n}" for r, n in sorted(by_reason.items()))
+            + ")"
+        )
+
     streamed = [e for e in events if e.get("kind") == "round_stream"]
     if streamed:
         out.append(

@@ -55,7 +55,7 @@ def build_parser() -> argparse.ArgumentParser:
     )
     ap.add_argument(
         "--kinds", type=_csv, default=None,
-        help="comma-separated program kinds: chunk,sweep,neural_chunk",
+        help="comma-separated program kinds: chunk,sweep,neural_chunk,serve",
     )
     ap.add_argument(
         "--placements", type=_csv, default=None,

@@ -10,7 +10,13 @@ without data, devices beyond the host, or compilation:
 - ``sweep``        — the vmapped experiment-batched chunk (runtime/sweep.py
                      ``make_sweep_chunk_fn``), per registered strategy;
 - ``neural_chunk`` — the fused neural AL chunk (runtime/neural_loop.py
-                     ``make_neural_chunk_fn``), per fusable deep strategy.
+                     ``make_neural_chunk_fn``), per fusable deep strategy;
+- ``serve``        — the streaming service's programs (serving/slab.py):
+                     the slab ``ingest`` donation-append, the resident
+                     ``score`` endpoint, and the serve ``chunk`` — the fused
+                     AL chunk with the dynamic ``n_filled`` watermark leaf
+                     riding the carry (the aval set a re-fit launch threads
+                     launch-to-launch).
 
 Each kind comes in two placements: ``cpu`` (single device) and ``mesh4x2``
 (the 4x2 data x model mesh with the pallas kernel shard_map-wrapped — the
@@ -48,9 +54,11 @@ SWEEP_E = 3
 LABEL_CAP = 40
 FIT_BUDGET = 48
 
-KINDS = ("chunk", "sweep", "neural_chunk")
+KINDS = ("chunk", "sweep", "neural_chunk", "serve")
 PLACEMENTS = ("cpu", "mesh4x2")
 MESH_SHAPE = (4, 2)
+SERVE_BLOCK = 8
+SERVE_SCORE_WIDTH = 16
 
 
 class SkipProgram(Exception):
@@ -297,6 +305,102 @@ def _build_neural_chunk(strategy_name: str, placement: str) -> AuditUnit:
     )
 
 
+def _build_serve(program: str, placement: str) -> AuditUnit:
+    """The streaming-service programs (serving/): single-device by design —
+    multihost serving is the pod-sharding ROADMAP item."""
+    from distributed_active_learning_tpu.serving import slab as slab_lib
+
+    if placement != "cpu":
+        raise SkipProgram(
+            "the streaming service is single-process (pod-sharded serving is "
+            "a ROADMAP item); its programs have no mesh variant"
+        )
+    if program == "ingest":
+        slab = slab_lib.SlabPool(
+            x=_sds((POOL_ROWS, FEATURES), jnp.float32),
+            oracle_y=_sds((POOL_ROWS,), jnp.int32),
+            labeled_mask=_sds((POOL_ROWS,), jnp.bool_),
+            codes=_sds((POOL_ROWS, FEATURES), jnp.int32),
+            n_filled=_sds((), jnp.int32),
+            slab_rows=POOL_ROWS,
+        )
+        args = (
+            slab,                                         # donated slab carry
+            _sds((FEATURES, MAX_BINS - 1), jnp.float32),  # bin edges
+            _sds((SERVE_BLOCK, FEATURES), jnp.float32),   # block_x
+            _sds((SERVE_BLOCK,), jnp.int32),              # block_y
+            _sds((), jnp.int32),                          # count
+        )
+        return AuditUnit(
+            name=f"serve/ingest/{placement}",
+            fn=slab_lib.make_ingest_fn(),
+            args=args,
+            expect_donation=True,
+            carry_in_argnums=(0,),
+            carry_out_index=0,
+        )
+    if program == "score":
+        # The endpoint evaluates whatever forest pytree this configuration's
+        # fit program produces — eval_shape of the fit gives its avals.
+        forest = jax.eval_shape(
+            _device_fit("gemm"),
+            _sds((POOL_ROWS, FEATURES), jnp.int32),
+            _abstract_state(),
+            _key_sds(),
+        )
+        args = (forest, _sds((SERVE_SCORE_WIDTH, FEATURES), jnp.float32))
+        return AuditUnit(
+            name=f"serve/score/{placement}",
+            fn=slab_lib.make_score_fn(),
+            args=args,
+            expect_donation=False,
+        )
+    if program == "chunk":
+        # The batch chunk program with the dynamic fill watermark riding the
+        # carry: one extra int32 leaf that must thread launch-to-launch with
+        # identical avals (the arrivals-never-recompile contract).
+        from distributed_active_learning_tpu.runtime import state as state_lib
+        from distributed_active_learning_tpu.runtime.loop import make_chunk_fn
+
+        strategy, aux = _strategy_and_aux("uncertainty")
+        chunk_fn = make_chunk_fn(
+            strategy, WINDOW, CHUNK_ROUNDS, _device_fit("gemm"), LABEL_CAP,
+            with_metrics=True,
+            n_classes=2,
+        )
+        state = state_lib.PoolState(
+            x=_sds((POOL_ROWS, FEATURES), jnp.float32),
+            oracle_y=_sds((POOL_ROWS,), jnp.int32),
+            labeled_mask=_sds((POOL_ROWS,), jnp.bool_),
+            key=_key_sds(),
+            round=_sds((), jnp.int32),
+            n_filled=_sds((), jnp.int32),
+        )
+        args = (
+            _sds((POOL_ROWS, FEATURES), jnp.int32),     # codes
+            state,                                       # donated slab carry
+            aux,
+            _key_sds(),                                  # fit_key
+            _sds((TEST_ROWS, FEATURES), jnp.float32),    # test_x
+            _sds((TEST_ROWS,), jnp.int32),               # test_y
+            _sds((), jnp.int32),                         # end_round
+        )
+        return AuditUnit(
+            name=f"serve/chunk/{placement}",
+            fn=chunk_fn,
+            args=args,
+            expect_donation=True,
+            with_metrics=True,
+            carry_in_argnums=(1,),
+            carry_out_index=0,
+        )
+    raise ValueError(f"unknown serve program {program!r}")
+
+
+def serve_program_names() -> List[str]:
+    return ["chunk", "ingest", "score"]
+
+
 # ---------------------------------------------------------------------------
 # the registry
 # ---------------------------------------------------------------------------
@@ -340,15 +444,16 @@ def build_registry(
         ("chunk", _build_chunk, forest_strategy_names()),
         ("sweep", _build_sweep, forest_strategy_names()),
         ("neural_chunk", _build_neural_chunk, neural_strategy_names()),
+        ("serve", _build_serve, serve_program_names()),
     ):
         if kind not in kinds:
             continue
-        # the neural loop has a single (cpu) placement — emit it only when
-        # cpu was requested, so a mesh-only filter doesn't smuggle cpu
-        # programs back into the audit
+        # the neural loop and the serving programs have a single (cpu)
+        # placement — emit it only when cpu was requested, so a mesh-only
+        # filter doesn't smuggle cpu programs back into the audit
         kind_placements = (
             (("cpu",) if "cpu" in placements else ())
-            if kind == "neural_chunk"
+            if kind in ("neural_chunk", "serve")
             else placements
         )
         for name in names:

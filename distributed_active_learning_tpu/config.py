@@ -117,6 +117,40 @@ class MeshConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Streaming AL service knobs (serving/service.py).
+
+    The service holds a slab-paged pool: capacity is allocated in fixed
+    ``slab_rows``-row slabs (static shapes per capacity; growth is
+    slab-at-a-time) and a dynamic fill watermark tracks how much of it holds
+    real points, so per-arrival ingest never changes a program's avals —
+    arrivals never recompile. Ingest and scoring both run at fixed widths
+    (``ingest_block`` / ``score_width``), padded per call, for the same
+    reason.
+    """
+
+    slab_rows: int = 1024      # rows per slab (capacity growth quantum)
+    ingest_block: int = 64     # static ingest write width (arrivals padded)
+    score_width: int = 64      # static scoring batch width (queries padded)
+    refit_rounds: int = 4      # AL rounds fused into one re-fit chunk launch
+    # Drift-aware re-fit triggers (serving/drift.py), evaluated against the
+    # last chunk's in-scan RoundMetrics baseline: a relative shift of the
+    # serve-time prediction entropy or of the chunk's selection margin beyond
+    # these thresholds dispatches a chunk instead of a fixed round cadence.
+    drift_entropy_shift: float = 0.25
+    drift_margin_shift: float = 0.5
+    # Fresh (ingested, unlabeled) points required before a drift trigger may
+    # fire — a re-fit with nothing new to label is wasted work.
+    drift_min_fresh: int = 32
+    # Staleness backstop: force a re-fit after this many scoring requests
+    # without one (0 disables). The cadence-of-last-resort, not the trigger.
+    max_staleness: int = 512
+    # Pending score requests tolerated before an in-flight re-fit chunk's
+    # touchdown is forced (the event loop otherwise polls non-blockingly).
+    refit_poll_events: int = 64
+
+
+@dataclasses.dataclass(frozen=True)
 class ExperimentConfig:
     """Top-level AL experiment: dataset + model + strategy + loop controls."""
 

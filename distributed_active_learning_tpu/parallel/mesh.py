@@ -117,6 +117,11 @@ def shard_pool_state(state: PoolState, mesh: Mesh) -> PoolState:
         labeled_mask=global_put(state.labeled_mask, mesh, mask_spec()),
         key=global_put(state.key, mesh, replicated_spec()),
         round=global_put(state.round, mesh, replicated_spec()),
+        n_filled=(
+            None
+            if state.n_filled is None
+            else global_put(state.n_filled, mesh, replicated_spec())
+        ),
     )
 
 

@@ -433,6 +433,149 @@ def restore_latest_sweep(
     return masks, key_data, rounds, results
 
 
+_SERVE_STEP_RE = re.compile(r"^servestate_(\d+)\.npz$")
+
+
+def save_serve(
+    ckpt_dir: str,
+    state: PoolState,
+    forest,
+    result: ExperimentResult,
+    fingerprint: Optional[str] = None,
+) -> Optional[str]:
+    """Streaming-service checkpoint: slab fill watermark + mask + ingested
+    points + the resident fitted forest.
+
+    Unlike the batch formats, the pool FEATURES are stored (sliced to the
+    fill watermark): a service's pool is not reproducible from the dataset
+    config — its tail arrived over the wire, and "resume without replaying
+    ingest" is the whole point. The resident forest rides as flattened
+    numbered arrays (like :func:`save_neural`'s network pytrees) so a
+    restarted service answers its first query from the pre-kill model
+    without waiting out a re-fit. Slab capacity is deliberately NOT stored:
+    it is an allocation detail, and the restore re-pads to the restoring
+    service's own ``slab_rows`` (the slab-growth parity tests prove tail
+    content is unobservable).
+    """
+    from distributed_active_learning_tpu.parallel.multihost import host_np
+
+    if state.n_filled is None:
+        raise ValueError("save_serve needs a slab-paged state (n_filled set)")
+    fill = int(state.n_filled)
+    # Like save()/save_neural(), the payload is built BEFORE the primary-only
+    # gate: host_np is a collective for multi-process sharded arrays, so
+    # every rank must reach it (serving is single-process today, but this
+    # module's contract is uniform).
+    payload = {
+        "x": host_np(state.x)[:fill],
+        "oracle_y": host_np(state.oracle_y)[:fill],
+        "labeled_mask": host_np(state.labeled_mask)[:fill],
+        "n_filled": np.asarray(fill, dtype=np.int32),
+        "key": np.asarray(jax.random.key_data(state.key)),
+        "round": np.asarray(int(state.round), dtype=np.int32),
+        "records_json": np.frombuffer(
+            json.dumps([dataclasses.asdict(r) for r in result.records]).encode(),
+            dtype=np.uint8,
+        ),
+    }
+    for i, leaf in enumerate(jax.tree_util.tree_leaves(forest)):
+        payload[f"forest_leaf_{i}"] = np.asarray(leaf)
+    if fingerprint is not None:
+        payload["config_fingerprint"] = np.frombuffer(
+            fingerprint.encode(), dtype=np.uint8
+        )
+    if jax.process_index() != 0:
+        return None
+    os.makedirs(ckpt_dir, exist_ok=True)
+    from distributed_active_learning_tpu.utils.io import atomic_savez
+
+    return atomic_savez(
+        os.path.join(ckpt_dir, f"servestate_{int(state.round)}.npz"), **payload
+    )
+
+
+def latest_serve_step(ckpt_dir: str) -> Optional[int]:
+    if not os.path.isdir(ckpt_dir):
+        return None
+    steps = [
+        int(m.group(1))
+        for fn in os.listdir(ckpt_dir)
+        if (m := _SERVE_STEP_RE.match(fn))
+    ]
+    return max(steps) if steps else None
+
+
+def restore_latest_serve(
+    ckpt_dir: str,
+    forest_template,
+    fingerprint: Optional[str] = None,
+):
+    """Load the newest service checkpoint; ``None`` if none exists.
+
+    Returns ``(x, y, labeled_mask, n_filled, key_data, round, forest,
+    result)`` — host arrays plus the forest rebuilt against
+    ``forest_template`` (the pytree ``jax.eval_shape`` of the service's own
+    fit program produces; leaf count/shape mismatches mean a differently-
+    configured forest and raise rather than resume garbage). A fingerprint
+    mismatch raises, as in :func:`restore_latest`.
+    """
+    step = latest_serve_step(ckpt_dir)
+    if step is None:
+        return None
+    with np.load(os.path.join(ckpt_dir, f"servestate_{step}.npz")) as z:
+        stored_fp = (
+            bytes(z["config_fingerprint"]).decode()
+            if "config_fingerprint" in z.files
+            else None
+        )
+        if fingerprint is not None and stored_fp is not None and stored_fp != fingerprint:
+            raise ValueError(
+                f"serve checkpoint fingerprint {stored_fp} != current service "
+                f"{fingerprint}: refusing to resume a different service's pool"
+            )
+        x = z["x"]
+        y = z["oracle_y"]
+        mask = z["labeled_mask"]
+        n_filled = int(z["n_filled"])
+        key_data = z["key"]
+        rnd = z["round"]
+        records = json.loads(bytes(z["records_json"]).decode())
+        leaves, treedef = jax.tree_util.tree_flatten(forest_template)
+        stored = sorted(
+            int(k[len("forest_leaf_"):])
+            for k in z.files
+            if k.startswith("forest_leaf_")
+        )
+        if stored != list(range(len(leaves))):
+            raise ValueError(
+                f"servestate_{step}.npz holds {len(stored)} forest arrays but "
+                f"this configuration's forest has {len(leaves)} — not a "
+                "checkpoint of this forest shape"
+            )
+        new_leaves = []
+        for i, tmpl in enumerate(leaves):
+            arr = z[f"forest_leaf_{i}"]
+            if tuple(arr.shape) != tuple(tmpl.shape):
+                raise ValueError(
+                    f"serve checkpoint forest leaf {i} shape {arr.shape} != "
+                    f"expected {tuple(tmpl.shape)}: different forest "
+                    "configuration"
+                )
+            new_leaves.append(jnp.asarray(arr, dtype=tmpl.dtype))
+    forest = jax.tree_util.tree_unflatten(treedef, new_leaves)
+    if x.shape[0] != n_filled:
+        raise ValueError(
+            f"serve checkpoint stores {x.shape[0]} rows but watermark is "
+            f"{n_filled}: truncated or corrupt file"
+        )
+    known = {f.name for f in dataclasses.fields(RoundRecord)}
+    result = ExperimentResult(
+        records=[RoundRecord(**{k: v for k, v in r.items() if k in known})
+                 for r in records]
+    )
+    return x, y, mask, n_filled, key_data, rnd, forest, result
+
+
 def save_neural(
     ckpt_dir: str,
     state: PoolState,

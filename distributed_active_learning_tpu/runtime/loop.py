@@ -63,7 +63,10 @@ def _round_core(
     state = state.replace(key=key)
     with jax.named_scope("al/score"):
         scores = strategy.score(forest, state, k_score, aux)
-    unlabeled = ~state.labeled_mask
+    # unlabeled_mask (not ~labeled_mask): streaming slab pools additionally
+    # exclude allocated-but-unfilled rows past the dynamic fill watermark;
+    # for batch pools (n_filled is None) this is the same expression.
+    unlabeled = state.unlabeled_mask
     with jax.named_scope("al/select"):
         if strategy.higher_is_better:
             vals, picked = select_top_k(scores, unlabeled, window_size)

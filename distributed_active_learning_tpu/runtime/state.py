@@ -11,6 +11,8 @@ host round-trips.
 
 from __future__ import annotations
 
+from typing import Optional
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -38,6 +40,14 @@ class PoolState:
     # never picks them, and masked out of every real-point statistic via
     # valid_mask. Static (not a pytree leaf) so jitted rounds specialize on it.
     n_valid_static: int = struct.field(pytree_node=False, default=-1)
+    # Dynamic fill watermark (slab-paged streaming pools, serving/slab.py):
+    # a TRACED int32 scalar — rows at/past it are allocated-but-unfilled slab
+    # capacity, excluded from selection, fit gathers, and every statistic via
+    # the dynamic masks below. A leaf (unlike n_valid_static) so ingest can
+    # advance it launch-to-launch without changing any program's avals —
+    # arrivals never retrigger compilation. None (batch pools) keeps every
+    # mask/count on the static fast path, bit-identical to the pre-slab code.
+    n_filled: Optional[jnp.ndarray] = None
 
     @property
     def n_pool(self) -> int:
@@ -49,10 +59,18 @@ class PoolState:
 
     @property
     def valid_mask(self) -> jnp.ndarray:
-        return jnp.arange(self.n_pool) < self.n_valid
+        mask = jnp.arange(self.n_pool) < self.n_valid
+        if self.n_filled is not None:
+            mask = mask & (jnp.arange(self.n_pool) < self.n_filled)
+        return mask
 
     @property
     def unlabeled_mask(self) -> jnp.ndarray:
+        # Unfilled slab rows keep labeled_mask=False (ingest never touches the
+        # mask) and are excluded here instead, so strategies/selection see
+        # exactly the filled unlabeled rows.
+        if self.n_filled is not None:
+            return ~self.labeled_mask & (jnp.arange(self.n_pool) < self.n_filled)
         return ~self.labeled_mask
 
     def visible_y(self, fill: int = -1) -> jnp.ndarray:
@@ -61,14 +79,14 @@ class PoolState:
 
 
 def labeled_count(state: PoolState) -> jnp.ndarray:
-    """Number of *real* labeled points (padding rows never count)."""
-    if state.n_valid == state.n_pool:
+    """Number of *real* labeled points (padding/unfilled rows never count)."""
+    if state.n_filled is None and state.n_valid == state.n_pool:
         return jnp.sum(state.labeled_mask.astype(jnp.int32))
     return jnp.sum((state.labeled_mask & state.valid_mask).astype(jnp.int32))
 
 
 def unlabeled_count(state: PoolState) -> jnp.ndarray:
-    return jnp.sum((~state.labeled_mask).astype(jnp.int32))
+    return jnp.sum(state.unlabeled_mask.astype(jnp.int32))
 
 
 def init_pool_state(x, y, key: jax.Array) -> PoolState:

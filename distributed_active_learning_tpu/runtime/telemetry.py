@@ -181,7 +181,15 @@ def _selection_metrics(
     score_max = jnp.where(jnp.isfinite(score_max), score_max, 0.0)
     margin = jnp.where(jnp.isfinite(margin), margin, 0.0)
 
-    ent_mean = jnp.sum(jnp.where(valid, pool_entropy, 0.0)) / state.n_valid
+    # Real-row denominator: static for batch pools; for streaming slab pools
+    # (state.n_filled set) the row count is a traced watermark, so it must be
+    # reduced from the dynamic valid mask — dividing by the static capacity
+    # would dilute entropy/labeled-fraction by the unfilled slab tail.
+    if state.n_filled is None:
+        n_real = state.n_valid
+    else:
+        n_real = jnp.maximum(jnp.sum(valid.astype(jnp.int32)), 1)
+    ent_mean = jnp.sum(jnp.where(valid, pool_entropy, 0.0)) / n_real
 
     hist = jnp.sum(
         jax.nn.one_hot(state.oracle_y[picked], n_classes, dtype=jnp.int32)
@@ -189,7 +197,7 @@ def _selection_metrics(
         axis=0,
     )
     labeled_frac = (
-        state_lib.labeled_count(state).astype(jnp.float32) / state.n_valid
+        state_lib.labeled_count(state).astype(jnp.float32) / n_real
     )
     return RoundMetrics(
         score_min=score_min.astype(jnp.float32),
@@ -382,20 +390,35 @@ class MetricsWriter:
     the crashed run's stream, not truncate the very post-mortem record it
     exists to keep; each resume starts with a fresh ``meta`` event, so
     consumers can segment runs.
+
+    ``flush_every`` batches flushes: the default 1 keeps the original
+    flush-per-event post-mortem guarantee (event volume in the batch drivers
+    is a handful per touchdown), while the streaming service — which emits
+    one ``serve_latency`` event PER QUERY on its hot path — passes a larger
+    value and relies on :func:`install_exit_flush` (SIGTERM/atexit) to keep
+    the buffered tail on a kill.
     """
 
-    def __init__(self, path: str, rank: Optional[int] = None):
+    def __init__(
+        self, path: str, rank: Optional[int] = None, flush_every: int = 1
+    ):
         import threading
 
         self.path = path
         self.rank = jax.process_index() if rank is None else rank
+        self.flush_every = max(int(flush_every), 1)
+        self._since_flush = 0
         self.counters: Dict[str, float] = {}
         self._f = None
         # Serializes line writes: the --stream-rounds path emits events from
         # the jax.debug.callback runtime thread CONCURRENTLY with the main
         # thread's touchdown events, and two interleaved self._f.write calls
-        # would corrupt the JSONL stream.
-        self._lock = threading.Lock()
+        # would corrupt the JSONL stream. REENTRANT: install_exit_flush's
+        # SIGTERM handler runs on the main thread and may interrupt an
+        # in-progress event() that already holds the lock — a plain Lock
+        # would deadlock the shutdown path there; re-entering flush() mid-
+        # write is safe (the partial line stays buffered in order).
+        self._lock = threading.RLock()
         if self._is_primary():
             parent = os.path.dirname(os.path.abspath(path))
             os.makedirs(parent, exist_ok=True)
@@ -427,11 +450,15 @@ class MetricsWriter:
             if self._f is None:  # closed between the fast check and here
                 return
             self._f.write(text)
-            # Flush per event: the stream's whole point is post-mortem
-            # visibility, and a SIGKILLed/preempted run never reaches
-            # close() — event volume is host-side and low (a handful per
-            # touchdown), so this is cheap.
-            self._f.flush()
+            # Flush per event by default: the stream's whole point is
+            # post-mortem visibility, and a SIGKILLed/preempted run never
+            # reaches close(). High-rate producers (the serve loop's
+            # per-query latency events) raise flush_every and install the
+            # SIGTERM/atexit flush instead (install_exit_flush).
+            self._since_flush += 1
+            if self._since_flush >= self.flush_every:
+                self._f.flush()
+                self._since_flush = 0
 
     # -- the event vocabulary ------------------------------------------------
 
@@ -505,6 +532,7 @@ class MetricsWriter:
         with self._lock:
             if self._f is not None:
                 self._f.flush()
+                self._since_flush = 0
 
     def close(self) -> None:
         with self._lock:
@@ -518,6 +546,60 @@ class MetricsWriter:
 
     def __exit__(self, *exc):
         self.close()
+
+
+def install_exit_flush(writer: MetricsWriter) -> None:
+    """Flush ``writer`` on SIGTERM and at interpreter exit.
+
+    Long-running service runs buffer their JSONL stream (``flush_every`` >
+    1), and an orchestrator kill (``timeout``/k8s preemption SIGTERMs before
+    SIGKILLing) would otherwise lose the buffered tail — exactly the events
+    that explain the kill. The SIGTERM handler flushes and then CHAINS to the
+    previously-installed handler (bench.py's JSON-printing unwinder, the
+    default terminator, ...), so installing this never changes a process's
+    shutdown semantics — it only makes the stream durable first. Idempotent
+    per writer; atexit covers clean exits and SIGINT's KeyboardInterrupt
+    unwind.
+    """
+    import atexit
+    import signal
+
+    if getattr(writer, "_exit_flush_installed", False):
+        return
+    writer._exit_flush_installed = True
+    atexit.register(writer.flush)
+
+    prev = signal.getsignal(signal.SIGTERM)
+    if prev is None:
+        # A handler installed from C — unknowable and unchainable. Replacing
+        # it would either drop that handler or (worse) leave the process
+        # ignoring SIGTERM after our flush; leave it alone and rely on the
+        # atexit flush instead.
+        return
+
+    def _flush_and_chain(signum, frame):
+        try:
+            writer.flush()
+        except RuntimeError:
+            # Signal landed inside the io stack's own C-level write: CPython
+            # forbids the reentrant flush. The interrupted write completes
+            # (and flushes) when the frame resumes; chaining matters more
+            # than this one flush.
+            pass
+        if callable(prev):
+            prev(signum, frame)
+        else:
+            # SIG_DFL (or SIG_IGN, where flushing was the only work to do):
+            # re-deliver with the default disposition so the exit status
+            # still reports death-by-SIGTERM.
+            if prev == signal.SIG_DFL:
+                signal.signal(signal.SIGTERM, signal.SIG_DFL)
+                signal.raise_signal(signal.SIGTERM)
+
+    try:
+        signal.signal(signal.SIGTERM, _flush_and_chain)
+    except ValueError:
+        pass  # non-main thread: atexit still covers clean exits
 
 
 class LaunchTracker:

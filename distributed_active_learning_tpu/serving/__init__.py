@@ -1,0 +1,27 @@
+"""Streaming AL as an online service (ROADMAP "heavy traffic" direction).
+
+- :mod:`serving.slab` — the slab-paged pool: static slab-quantized capacity,
+  dynamic fill watermark, donation ingest, fixed-width resident scoring;
+- :mod:`serving.drift` — entropy/margin drift triggers deciding when a
+  re-fit chunk launch is worth dispatching;
+- :mod:`serving.service` — the event loop interleaving ingest drains, the
+  ``score(points)`` endpoint, and drift-gated fused AL chunk launches.
+
+Entry points: ``python -m distributed_active_learning_tpu.serving`` (a
+simulated stream over a registry dataset) and ``bench.py --mode serve`` (the
+sustained-qps / p99-latency benchmark).
+"""
+
+from distributed_active_learning_tpu.serving.drift import DriftMonitor  # noqa: F401
+from distributed_active_learning_tpu.serving.service import (  # noqa: F401
+    ALService,
+    ServeStats,
+)
+from distributed_active_learning_tpu.serving.slab import (  # noqa: F401
+    SlabPool,
+    flat_state,
+    grow_slab,
+    init_slab_pool,
+    make_ingest_fn,
+    make_score_fn,
+)

@@ -1,0 +1,237 @@
+"""Slab-paged streaming pool: fixed-capacity slabs + donation ingest.
+
+The batch pipeline's :class:`~runtime.state.PoolState` is sized once per
+experiment — fine for a thesis reproduction, fatal for a service where points
+arrive continuously: naively appending a row changes every array's shape and
+recompiles every program on every arrival. The slab design splits "how much
+memory is allocated" from "how much of it is real":
+
+- **Capacity is slab-quantized and static.** Pool arrays are allocated in
+  fixed ``slab_rows``-row slabs; every program specializes on the capacity,
+  and growth (rare, slab-at-a-time) is the ONLY shape change — one compile
+  per capacity ever reached, never one per arrival.
+
+- **The fill is a dynamic watermark.** ``PoolState.n_filled`` is a traced
+  int32 leaf: rows at/past it are allocated-but-unfilled tail, excluded from
+  selection/fit/metrics by the dynamic masks in ``runtime/state.py``. Ingest
+  advances the watermark launch-to-launch with identical avals — arrivals
+  never retrigger compilation (pinned by tests/test_serving.py's jit-cache
+  assertions).
+
+- **Ingest is an in-place donation write.** :func:`make_ingest_fn` builds a
+  jitted program that donates the slab arrays and writes a fixed-width block
+  at the watermark via ``dynamic_update_slice`` — the service's hot append
+  path costs one aliased launch, no host round-trip of the pool. Arrivals
+  smaller than the block width are padded; the pad rows land past the
+  advanced watermark and are overwritten by the next block.
+
+- **Scoring is capacity-independent.** :func:`make_score_fn` evaluates the
+  resident fitted forest over a fixed-width query batch — its program never
+  depends on the pool at all, so it compiles exactly once for the service's
+  lifetime.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from distributed_active_learning_tpu.runtime import state as state_lib
+
+
+@struct.dataclass
+class SlabPool:
+    """Device-resident slab-paged pool.
+
+    ``labeled_mask`` rows past the watermark stay False (ingest never touches
+    the mask — fresh points arrive unlabeled); consumers exclude the unfilled
+    tail through ``PoolState``'s dynamic masks instead. ``codes`` holds the
+    binned features the device trainer consumes, kept in lockstep with ``x``
+    by the ingest program so a re-fit launch needs no re-binning pass.
+    """
+
+    x: jnp.ndarray             # [capacity, d] float32
+    oracle_y: jnp.ndarray      # [capacity] int32
+    labeled_mask: jnp.ndarray  # [capacity] bool
+    codes: jnp.ndarray         # [capacity, d] int32 — binned features
+    n_filled: jnp.ndarray      # scalar int32 — dynamic fill watermark
+    slab_rows: int = struct.field(pytree_node=False, default=1024)
+
+    @property
+    def capacity(self) -> int:
+        return self.x.shape[0]
+
+    @property
+    def n_slabs(self) -> int:
+        return self.capacity // self.slab_rows
+
+
+def slab_capacity(n_rows: int, slab_rows: int) -> int:
+    """Smallest slab-multiple capacity holding ``n_rows`` (at least 1 slab)."""
+    return max(-(-n_rows // slab_rows), 1) * slab_rows
+
+
+def init_slab_pool(
+    x,
+    y,
+    labeled_mask,
+    edges: jnp.ndarray,
+    slab_rows: int,
+) -> SlabPool:
+    """Allocate a slab pool holding the initial points.
+
+    The unfilled tail is zero content with ``labeled_mask=False`` — the
+    watermark, not the stored values, is what keeps it out of every program
+    (the slab-growth parity tests prove the discipline: tail content is
+    unobservable).
+    """
+    from distributed_active_learning_tpu.ops import trees_train
+
+    x = jnp.asarray(x, jnp.float32)
+    y = jnp.asarray(y, jnp.int32)
+    mask = jnp.asarray(labeled_mask, bool)
+    n = x.shape[0]
+    cap = slab_capacity(n, slab_rows)
+    codes = trees_train.code_features(x, edges)
+    pad = cap - n
+    return SlabPool(
+        x=jnp.pad(x, ((0, pad), (0, 0))),
+        oracle_y=jnp.pad(y, (0, pad)),
+        labeled_mask=jnp.pad(mask, (0, pad)),
+        codes=jnp.pad(codes, ((0, pad), (0, 0))),
+        n_filled=jnp.asarray(n, jnp.int32),
+        slab_rows=slab_rows,
+    )
+
+
+def grow_slab(pool: SlabPool, n_slabs: int = 1) -> SlabPool:
+    """Extend capacity by ``n_slabs`` fresh (unfilled) slabs.
+
+    The one legitimate shape change of a service's lifetime: programs for the
+    new capacity compile once when first used; the watermark and all filled
+    content carry over untouched.
+    """
+    pad = n_slabs * pool.slab_rows
+    return pool.replace(
+        x=jnp.pad(pool.x, ((0, pad), (0, 0))),
+        oracle_y=jnp.pad(pool.oracle_y, (0, pad)),
+        labeled_mask=jnp.pad(pool.labeled_mask, (0, pad)),
+        codes=jnp.pad(pool.codes, ((0, pad), (0, 0))),
+    )
+
+
+def flat_state(
+    pool: SlabPool, key: jax.Array, round_: jnp.ndarray
+) -> state_lib.PoolState:
+    """The :class:`PoolState` view a fused AL chunk consumes — the SAME
+    arrays (no copies), with the watermark riding as the dynamic
+    ``n_filled`` leaf so the chunk's selection/fit/metrics mask the unfilled
+    tail."""
+    return state_lib.PoolState(
+        x=pool.x,
+        oracle_y=pool.oracle_y,
+        labeled_mask=pool.labeled_mask,
+        key=key,
+        round=round_,
+        n_filled=pool.n_filled,
+    )
+
+
+def make_ingest_fn():
+    """Build the jitted donation-append program.
+
+    ``ingest(pool, edges, block_x, block_y, count) -> (pool, n_filled)``
+    writes a fixed-width block at the watermark (donating the slab arrays —
+    the write is in place, no pool copy), bins the block's features against
+    the service's frozen edges inside the same program, and advances the
+    watermark by ``count`` (the block's REAL rows; pad rows land past the new
+    watermark and are overwritten by the next block). The post-ingest
+    watermark also returns as a separate scalar — the one value host
+    accounting may fetch without touching the slab arrays (the ingest twin of
+    the chunk's :class:`~runtime.pipeline.ChunkExtras`).
+
+    Each factory call returns a FRESH jit closure: the service builds one per
+    capacity, so a program instance's jit cache holds exactly one executable
+    and any growth past it is a loud recompile signal rather than silent
+    cache churn (the ``recompiles_after_warmup`` accounting in
+    serving/service.py keys on this).
+
+    The caller must guarantee ``n_filled + block_rows <= capacity`` (grow
+    first); ``dynamic_update_slice`` would otherwise clamp the start index
+    and silently overwrite the newest filled rows.
+    """
+    from distributed_active_learning_tpu.ops import trees_train
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def ingest(
+        pool: SlabPool,
+        edges: jnp.ndarray,
+        block_x: jnp.ndarray,
+        block_y: jnp.ndarray,
+        count: jnp.ndarray,
+    ) -> Tuple[SlabPool, jnp.ndarray]:
+        with jax.named_scope("serve/ingest"):
+            fill = pool.n_filled
+            block_codes = trees_train.code_features(block_x, edges)
+            new_pool = pool.replace(
+                x=jax.lax.dynamic_update_slice(pool.x, block_x, (fill, 0)),
+                oracle_y=jax.lax.dynamic_update_slice(
+                    pool.oracle_y, block_y, (fill,)
+                ),
+                codes=jax.lax.dynamic_update_slice(
+                    pool.codes, block_codes, (fill, 0)
+                ),
+                n_filled=fill + count,
+            )
+        return new_pool, new_pool.n_filled
+
+    return ingest
+
+
+def make_score_fn():
+    """Build the resident-forest scoring endpoint program.
+
+    ``score(forest, queries[B, d]) -> (scores[B], entropy[B])`` — the
+    model's confidence per query (P(class 1) for binary forests, the
+    predicted class's probability for multiclass) plus the predictive
+    entropy the drift monitor consumes. Fixed query width ``B`` (callers
+    pad), no pool dependence: one compile for the service's lifetime, and
+    re-fitted forests of the same configuration reuse the executable.
+    """
+    from distributed_active_learning_tpu.ops import forest_eval, scoring, trees_multi
+
+    @jax.jit
+    def score(forest, queries: jnp.ndarray):
+        with jax.named_scope("serve/score"):
+            if trees_multi.is_multi(forest):
+                probs = trees_multi.proba_multi(forest, queries)
+                scores = jnp.max(probs, axis=-1)
+                ent = trees_multi.entropy_multi(probs)
+            else:
+                p = forest_eval.proba(forest, queries)
+                scores = p
+                ent = scoring.full_entropy(p)
+        return scores.astype(jnp.float32), ent.astype(jnp.float32)
+
+    return score
+
+
+def pad_block(
+    x: np.ndarray, y: np.ndarray, block_rows: int
+) -> Tuple[np.ndarray, np.ndarray, int]:
+    """Host-side pad of an arrival to the static ingest width; returns
+    ``(block_x, block_y, count)`` with ``count`` the real rows."""
+    n = x.shape[0]
+    if n > block_rows:
+        raise ValueError(f"arrival of {n} rows exceeds ingest block {block_rows}")
+    pad = block_rows - n
+    bx = np.zeros((block_rows, x.shape[1]), np.float32)
+    bx[:n] = x
+    by = np.zeros((block_rows,), np.int32)
+    by[:n] = y
+    return bx, by, n

@@ -1,0 +1,549 @@
+"""Streaming AL service: slab-paged ingest, resident scoring, drift re-fit.
+
+The load-bearing guarantees pinned here:
+
+- **Watermark discipline is airtight** — a pool grown slab-at-a-time under
+  incremental ingest (with garbage past the watermark) runs the fused AL
+  chunk BIT-IDENTICALLY to a fresh fixed-size pool of the final capacity, on
+  CPU and the 4x2 mesh. Unfilled tail content is unobservable.
+- **Arrivals never recompile** — repeated ingests at one capacity leave the
+  program's jit cache at exactly one executable; growth compiles a fresh
+  instance per capacity, never silently churns an existing one.
+- **The service loop composes** — concurrent score/ingest traffic with
+  drift-triggered re-fits, zero recompiles after warmup, and a checkpoint
+  round-trip that resumes scoring bit-identically without ingest replay.
+- **Telemetry survives a kill** — a buffered MetricsWriter with the
+  SIGTERM/atexit flush keeps its tail events when the process is terminated.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_active_learning_tpu.config import (
+    ExperimentConfig,
+    ForestConfig,
+    ServeConfig,
+    StrategyConfig,
+)
+from distributed_active_learning_tpu.runtime import state as state_lib
+from distributed_active_learning_tpu.serving import drift as drift_lib
+from distributed_active_learning_tpu.serving import slab as slab_lib
+from distributed_active_learning_tpu.serving.service import ALService
+
+
+def _points(n, d=4, seed=0, shift=0.0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d)).astype(np.float32) + shift
+    y = (x[:, 0] + 0.3 * x[:, 1] > shift).astype(np.int32)
+    return x, y
+
+
+# ---------------------------------------------------------------------------
+# drift monitor (pure host arithmetic)
+# ---------------------------------------------------------------------------
+
+
+def test_drift_entropy_trigger_needs_fresh_points():
+    mon = drift_lib.DriftMonitor(
+        entropy_shift=0.2, min_fresh=10, max_staleness=0, ema=1.0
+    )
+    mon.observe_chunk([{"pool_entropy": 1.0, "score_margin": 0.5}])
+    mon.observe_serve(2.0)  # 100% relative shift
+    assert mon.should_refit() is None  # no fresh points yet
+    mon.observe_ingest(10)
+    assert mon.should_refit() == "entropy_shift"
+    # within threshold -> quiet
+    mon.observe_chunk([{"pool_entropy": 1.0, "score_margin": 0.5}])
+    mon.observe_ingest(10)
+    mon.observe_serve(1.1)
+    assert mon.should_refit() is None
+
+
+def test_drift_margin_shift_between_chunks():
+    mon = drift_lib.DriftMonitor(
+        entropy_shift=10.0, margin_shift=0.5, min_fresh=1, max_staleness=0
+    )
+    mon.observe_chunk([{"pool_entropy": 1.0, "score_margin": 0.4}])
+    mon.observe_ingest(5)
+    mon.observe_serve(1.0)
+    assert mon.should_refit() is None  # first chunk only sets the baseline
+    mon.observe_chunk([{"pool_entropy": 1.0, "score_margin": 0.05}])
+    mon.observe_ingest(5)
+    mon.observe_serve(1.0)
+    assert mon.should_refit() == "margin_shift"
+
+
+def test_drift_staleness_backstop_and_reset():
+    mon = drift_lib.DriftMonitor(entropy_shift=10.0, min_fresh=1, max_staleness=3)
+    for _ in range(2):
+        mon.observe_serve(1.0)
+    assert mon.should_refit() is None
+    mon.observe_serve(1.0)
+    assert mon.should_refit() == "staleness"
+    mon.observe_chunk([{"pool_entropy": 1.0, "score_margin": 0.1}])
+    assert mon.serves_since_refit == 0 and mon.fresh_points == 0
+    assert mon.should_refit() is None
+
+
+# ---------------------------------------------------------------------------
+# slab pool: watermark, ingest, growth
+# ---------------------------------------------------------------------------
+
+
+def _edges_for(x, bins=8):
+    from distributed_active_learning_tpu.ops import trees_train
+
+    return trees_train.make_bins(jnp.asarray(x), bins).edges
+
+
+def test_ingest_advances_watermark_and_masks():
+    x0, y0 = _points(20)
+    edges = _edges_for(x0)
+    mask0 = np.zeros(20, bool)
+    mask0[:4] = True
+    pool = slab_lib.init_slab_pool(x0, y0, mask0, edges, slab_rows=16)
+    assert pool.capacity == 32 and int(pool.n_filled) == 20
+
+    ingest = slab_lib.make_ingest_fn()
+    bx, by, count = slab_lib.pad_block(*_points(5, seed=1), 8)
+    pool, fill = ingest(pool, edges, jnp.asarray(bx), jnp.asarray(by), np.int32(count))
+    assert int(fill) == 25
+    st = slab_lib.flat_state(pool, jax.random.key(0), jnp.asarray(0, jnp.int32))
+    # dynamic masks: filled rows selectable, unfilled tail excluded everywhere
+    assert int(state_lib.labeled_count(st)) == 4
+    assert int(state_lib.unlabeled_count(st)) == 21
+    assert not bool(np.asarray(st.unlabeled_mask)[25:].any())
+    np.testing.assert_array_equal(
+        np.asarray(pool.x)[20:25], bx[:5]
+    )
+
+
+def test_ingest_jit_cache_flat_across_appends_and_growth():
+    """Arrivals never recompile: many appends at one capacity keep the
+    program's jit cache at exactly one executable; crossing a slab boundary
+    compiles a FRESH per-capacity instance (again size one) instead of
+    churning the old one."""
+    from distributed_active_learning_tpu.runtime.telemetry import jit_cache_size
+
+    x0, y0 = _points(8)
+    edges = _edges_for(x0)
+    pool = slab_lib.init_slab_pool(x0, y0, np.zeros(8, bool), edges, slab_rows=32)
+    fns = {}
+    compiled_capacities = []
+    for i in range(10):
+        if int(pool.n_filled) + 8 > pool.capacity:
+            pool = slab_lib.grow_slab(pool)
+        cap = pool.capacity
+        if cap not in fns:
+            fns[cap] = slab_lib.make_ingest_fn()
+            compiled_capacities.append(cap)
+        bx, by, count = slab_lib.pad_block(*_points(8, seed=i + 1), 8)
+        pool, _ = fns[cap](
+            pool, edges, jnp.asarray(bx), jnp.asarray(by), np.int32(count)
+        )
+    assert int(pool.n_filled) == 88
+    assert compiled_capacities == [32, 64, 96]
+    # flat across appends: one executable per capacity instance, ever
+    assert all(jit_cache_size(fn) == 1 for fn in fns.values())
+
+
+def _chunk_fn_for(capacity_pool, mesh=None, kernel="gemm"):
+    from distributed_active_learning_tpu.runtime.loop import (
+        make_chunk_fn,
+        make_device_fit,
+    )
+    from distributed_active_learning_tpu.strategies import StrategyAux, get_strategy
+
+    cfg = ExperimentConfig(
+        forest=ForestConfig(
+            n_trees=8, max_depth=3, max_bins=8, kernel=kernel, fit="device"
+        ),
+        strategy=StrategyConfig(name="uncertainty", window_size=5),
+    )
+    edges = capacity_pool["edges"]
+    fit = make_device_fit(cfg, edges, 48, 2)
+    strategy = get_strategy(cfg.strategy)
+    chunk = make_chunk_fn(
+        strategy, 5, 3, fit, label_cap=capacity_pool["capacity"],
+        mesh=mesh,
+        wrap_pallas=mesh is not None,
+        with_metrics=True,
+    )
+    aux = StrategyAux(
+        seed_mask=jnp.array(capacity_pool["seed_mask"], copy=True)
+    )
+    return chunk, aux
+
+
+def _grown_and_fresh_states(slab_rows=16):
+    """Build the two parity arms: a pool grown under incremental ingest
+    (with DELIBERATE garbage past the watermark) and a fresh fixed-size pool
+    of the final capacity holding the same points."""
+    x0, y0 = _points(20)
+    edges = _edges_for(x0)
+    mask0 = np.zeros(20, bool)
+    mask0[:6] = True
+
+    grown = slab_lib.init_slab_pool(x0, y0, mask0, edges, slab_rows)
+    fns = {}
+    stream_x, stream_y = _points(24, seed=3)
+    for lo in range(0, 24, 8):
+        if int(grown.n_filled) + 8 > grown.capacity:
+            grown = slab_lib.grow_slab(grown)
+        fns.setdefault(grown.capacity, slab_lib.make_ingest_fn())
+        bx = np.full((8, 4), 777.0, np.float32)  # junk pad past the count
+        by = np.full((8,), 7, np.int32)
+        count = 8 if lo < 16 else 4  # last block is partial: junk mid-slab
+        bx[:count] = stream_x[lo : lo + count]
+        by[:count] = stream_y[lo : lo + count]
+        grown, _ = fns[grown.capacity](
+            grown, edges, jnp.asarray(bx), jnp.asarray(by), np.int32(count)
+        )
+    n_final = 20 + 16 + 4
+    assert int(grown.n_filled) == n_final
+
+    all_x = np.concatenate([x0, stream_x[:16], stream_x[16:20]])
+    all_y = np.concatenate([y0, stream_y[:16], stream_y[16:20]])
+    all_mask = np.concatenate([mask0, np.zeros(20, bool)])
+    fresh = slab_lib.init_slab_pool(all_x, all_y, all_mask, edges, slab_rows)
+    assert fresh.capacity == grown.capacity  # same final capacity
+    # the two arms' tail content DIFFERS (junk vs zeros) — the chunk result
+    # must not see it
+    assert not np.array_equal(np.asarray(grown.x), np.asarray(fresh.x))
+    seed_mask = np.concatenate([mask0, np.zeros(grown.capacity - 20, bool)])
+    meta = {
+        "edges": edges,
+        "capacity": grown.capacity,
+        "seed_mask": seed_mask,
+        "n_final": n_final,
+    }
+    return grown, fresh, meta
+
+
+def _run_chunk(chunk, aux, pool, meta, mesh=None):
+    state = slab_lib.flat_state(
+        pool, jax.random.key(7), jnp.asarray(0, jnp.int32)
+    )
+    test_x = jnp.asarray(_points(16, seed=9)[0])
+    test_y = jnp.asarray(_points(16, seed=9)[1])
+    if mesh is not None:
+        from distributed_active_learning_tpu.parallel import (
+            mesh as mesh_lib,
+            shard_pool_state,
+        )
+
+        state = shard_pool_state(state, mesh)
+        test_x = mesh_lib.global_put(test_x, mesh, mesh_lib.replicated_spec())
+        test_y = mesh_lib.global_put(test_y, mesh, mesh_lib.replicated_spec())
+        codes = mesh_lib.global_put(pool.codes, mesh, mesh_lib.pool_spec())
+    else:
+        codes = pool.codes
+    out_state, extras, ys = chunk(
+        codes, state, aux, jax.random.key(11), test_x, test_y, 3
+    )
+    return out_state, extras, ys
+
+
+def _assert_parity(res_a, res_b, n_final):
+    (st_a, ex_a, ys_a), (st_b, ex_b, ys_b) = res_a, res_b
+    assert int(ex_a.n_labeled_after) == int(ex_b.n_labeled_after)
+    assert int(ex_a.n_active) == int(ex_b.n_active)
+    for ya, yb in zip(ys_a[:5], ys_b[:5]):
+        np.testing.assert_array_equal(np.asarray(ya), np.asarray(yb))
+    for la, lb in zip(
+        jax.tree_util.tree_leaves(ys_a[5]), jax.tree_util.tree_leaves(ys_b[5])
+    ):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+    np.testing.assert_array_equal(
+        np.asarray(st_a.labeled_mask)[:n_final],
+        np.asarray(st_b.labeled_mask)[:n_final],
+    )
+    np.testing.assert_array_equal(
+        np.asarray(jax.random.key_data(st_a.key)),
+        np.asarray(jax.random.key_data(st_b.key)),
+    )
+
+
+def test_slab_growth_bit_identical_to_fresh_pool_cpu():
+    grown, fresh, meta = _grown_and_fresh_states()
+    chunk, aux = _chunk_fn_for(meta)
+    res_grown = _run_chunk(chunk, aux, grown, meta)
+    res_fresh = _run_chunk(chunk, aux, fresh, meta)
+    _assert_parity(res_grown, res_fresh, meta["n_final"])
+    # and the fused chunk threaded the watermark through untouched
+    assert int(res_grown[0].n_filled) == meta["n_final"]
+
+
+def test_slab_growth_bit_identical_on_mesh(devices):
+    from distributed_active_learning_tpu.parallel import make_mesh
+
+    grown, fresh, meta = _grown_and_fresh_states()
+    assert meta["capacity"] % 4 == 0
+    mesh = make_mesh(data=4, model=2)
+    chunk, aux = _chunk_fn_for(meta, mesh=mesh, kernel="pallas")
+    res_grown = _run_chunk(chunk, aux, grown, meta, mesh=mesh)
+    res_fresh = _run_chunk(chunk, aux, fresh, meta, mesh=mesh)
+    _assert_parity(res_grown, res_fresh, meta["n_final"])
+
+
+# ---------------------------------------------------------------------------
+# the service loop
+# ---------------------------------------------------------------------------
+
+
+def _service_cfg():
+    cfg = ExperimentConfig(
+        forest=ForestConfig(
+            n_trees=6, max_depth=3, max_bins=8, fit="device", fit_budget=64
+        ),
+        strategy=StrategyConfig(name="uncertainty", window_size=4),
+        n_start=6,
+        log_every=0,
+    )
+    serve = ServeConfig(
+        slab_rows=64,
+        ingest_block=16,
+        score_width=16,
+        refit_rounds=2,
+        drift_entropy_shift=0.2,
+        drift_min_fresh=8,
+        max_staleness=5,
+        refit_poll_events=3,
+    )
+    return cfg, serve
+
+
+@pytest.fixture(scope="module")
+def driven_service(tmp_path_factory):
+    """One tiny service driven through real mixed traffic — scoring with
+    concurrent ingest crossing a slab boundary and at least one drift-
+    dispatched re-fit — shared by the assertions below (chunk compiles
+    dominate; one drive serves them all)."""
+    cfg, serve = _service_cfg()
+    x0, y0 = _points(48, seed=0)
+    tx, ty = _points(32, seed=1)
+    ckpt_dir = str(tmp_path_factory.mktemp("serve_ckpt"))
+    svc = ALService(cfg, serve, x0, y0, tx, ty, checkpoint_dir=ckpt_dir)
+    rng = np.random.default_rng(2)
+    stream_x, stream_y = _points(128, seed=3, shift=2.0)
+    pos = 0
+    scores = []
+    for i in range(14):
+        if i % 3 == 0 and pos < stream_x.shape[0]:
+            svc.submit(stream_x[pos : pos + 16], stream_y[pos : pos + 16])
+            pos += 16
+        q = tx[rng.integers(0, 32, size=8)]
+        scores.append(svc.score(q))
+    svc.flush()
+    return svc, scores, (tx, ty), (cfg, serve), (x0, y0)
+
+
+def test_service_serves_and_refits(driven_service):
+    svc, scores, _, _, _ = driven_service
+    assert all(s.shape == (8,) and np.isfinite(s).all() for s in scores)
+    s = svc.summary()
+    assert s["queries"] == 14
+    assert s["ingested_points"] == 80
+    assert s["refits"] >= 1 and s["refit_rounds"] >= 1
+    assert s["recompiles_after_warmup"] == 0
+    assert s["slab_growths"] >= 1  # 48 + 80 crosses the 64/128 boundaries
+    assert s["fill"] == 128 and s["capacity"] >= 128
+    assert s["labeled"] > 6  # re-fit rounds actually revealed labels
+
+
+def test_seed_mask_tracks_slab_capacity(driven_service):
+    """A seed-mask-consuming strategy must see a capacity-sized mask: the
+    cold-start pool is smaller than the slab arrays, and growth resizes them
+    again — the service re-pads the aux on both."""
+    svc, _, _, _, _ = driven_service
+    assert svc.stats.slab_growths >= 1
+    assert svc._aux.seed_mask.shape[0] == svc._slab.capacity
+
+
+def test_seed_mask_strategy_refits_after_growth():
+    """density(mass_over=non_seed) dots the seed mask against capacity-sized
+    pool vectors — a re-fit on a grown slab must not shape-error."""
+    cfg = ExperimentConfig(
+        forest=ForestConfig(
+            n_trees=6, max_depth=3, max_bins=8, fit="device", fit_budget=64
+        ),
+        strategy=StrategyConfig(
+            name="density", window_size=4, options={"mass_over": "non_seed"}
+        ),
+        n_start=6,
+        log_every=0,
+    )
+    serve = ServeConfig(
+        slab_rows=32, ingest_block=16, score_width=8, refit_rounds=2,
+        max_staleness=0,
+    )
+    x0, y0 = _points(20, seed=0)
+    tx, ty = _points(16, seed=1)
+    svc = ALService(cfg, serve, x0, y0, tx, ty)
+    sx, sy = _points(32, seed=2)
+    svc.submit(sx, sy)  # 20 + 32 rows crosses the 32-row slab boundary twice
+    assert svc.stats.slab_growths >= 1
+    assert svc.refit_now("test")
+    svc.flush()
+    assert svc.summary()["refit_rounds"] >= 1
+    assert svc.summary()["recompiles_after_warmup"] == 0
+
+
+def test_score_empty_batch_returns_empty(driven_service):
+    svc, _, _, _, _ = driven_service
+    out = svc.score(np.zeros((0, 4), np.float32))
+    assert out.shape == (0,) and out.dtype == np.float32
+
+
+def test_submit_refuses_out_of_range_label(driven_service):
+    """n_classes is frozen at cold start (static fit shapes, histogram
+    width); a label past it must be refused loudly, not binned away."""
+    svc, _, _, _, _ = driven_service
+    with pytest.raises(ValueError, match="out of range"):
+        svc.submit(np.zeros((1, 4), np.float32), np.asarray([svc.n_classes]))
+
+
+def test_service_checkpoint_roundtrip(driven_service):
+    """A killed service resumes from the serve checkpoint WITHOUT replaying
+    ingest: same fill, same labels, and the restored resident forest scores
+    bit-identically."""
+    svc, _, (tx, ty), (cfg, serve), (x0, y0) = driven_service
+    path = svc.save_checkpoint()
+    assert path and os.path.exists(path)
+    svc2 = ALService(
+        cfg, serve, x0, y0, tx, ty, checkpoint_dir=svc.checkpoint_dir
+    )
+    assert svc2._fill == svc._fill
+    assert svc2._labeled == svc._labeled
+    assert len(svc2.result.records) == len(svc.result.records)
+    q = tx[:8]
+    np.testing.assert_array_equal(svc.score(q), svc2.score(q))
+
+
+def test_serve_checkpoint_refuses_other_fingerprint(driven_service):
+    from distributed_active_learning_tpu.runtime import checkpoint as ckpt_lib
+
+    svc, _, _, _, _ = driven_service
+    svc.save_checkpoint()  # idempotent; the dir may already hold one
+    template = None  # fingerprint check fires before the forest rebuild
+    with pytest.raises(ValueError, match="refusing to resume"):
+        ckpt_lib.restore_latest_serve(
+            svc.checkpoint_dir, template, fingerprint="0" * 16
+        )
+
+
+# ---------------------------------------------------------------------------
+# telemetry satellites
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_writer_buffered_flush_on_sigterm(tmp_path):
+    """A buffered MetricsWriter (flush_every >> events) keeps its tail when
+    the process is SIGTERMed — install_exit_flush's handler flushes, then
+    chains to the default disposition (exit code still reports the TERM)."""
+    path = str(tmp_path / "serve.jsonl")
+    script = textwrap.dedent(f"""
+        import signal, sys, time
+        from distributed_active_learning_tpu.runtime.telemetry import (
+            MetricsWriter, install_exit_flush,
+        )
+        w = MetricsWriter({path!r}, rank=0, flush_every=100000)
+        install_exit_flush(w)
+        for i in range(25):
+            w.event("serve_latency", seconds=0.001 * i, batch=1)
+        print("READY", flush=True)
+        time.sleep(60)
+    """)
+    proc = subprocess.Popen(
+        [sys.executable, "-c", script],
+        stdout=subprocess.PIPE,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        text=True,
+    )
+    try:
+        assert proc.stdout.readline().strip() == "READY"
+        # buffered: nothing (or at most a partial OS block) should be durable
+        pre = os.path.getsize(path) if os.path.exists(path) else 0
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert rc == -signal.SIGTERM  # the default disposition still applied
+    with open(path) as f:
+        events = [json.loads(line) for line in f if line.strip()]
+    assert len(events) == 25, f"lost tail events (pre-kill bytes={pre})"
+    assert events[-1]["seconds"] == 0.024
+
+
+def test_metrics_writer_flush_every_buffers(tmp_path):
+    path = str(tmp_path / "buf.jsonl")
+    from distributed_active_learning_tpu.runtime.telemetry import MetricsWriter
+
+    w = MetricsWriter(path, rank=0, flush_every=10)
+    for i in range(9):
+        w.event("e", i=i)
+    # fewer than flush_every events: fsync'd content may be empty
+    w.flush()
+    with open(path) as f:
+        assert len(f.readlines()) == 9
+    w.close()
+
+
+def _load_summarize():
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "benches"))
+    try:
+        import summarize_metrics
+    finally:
+        sys.path.pop(0)
+    return summarize_metrics
+
+
+def test_summarize_serve_latency_and_ingest_tables():
+    sm = _load_summarize()
+    events = [
+        {"ts": 100.0 + 0.1 * i, "kind": "serve_latency",
+         "seconds": 0.010 * (i + 1), "batch": 4}
+        for i in range(10)
+    ]
+    events += [
+        {"ts": 100.0, "kind": "ingest", "points": 16, "seconds": 0.001,
+         "fill": 64, "capacity": 128},
+        {"ts": 101.0, "kind": "ingest", "points": 16, "seconds": 0.001,
+         "fill": 80, "capacity": 128},
+        {"ts": 101.5, "kind": "refit", "reason": "entropy_shift"},
+    ]
+    out = sm.summarize(events)
+    assert "== serve latency ==" in out
+    assert "p99 ms" in out and "100.000" in out  # max latency = 0.1 s
+    assert "== ingest ==" in out and "32" in out
+    assert "== refits ==" in out and "entropy_shift=1" in out
+
+
+def test_summarize_serve_sections_skip_malformed_events():
+    sm = _load_summarize()
+    events = [
+        {"ts": 1.0, "kind": "serve_latency", "seconds": 0.01},
+        {"ts": 1.1, "kind": "serve_latency"},               # no seconds
+        {"ts": 1.2, "kind": "serve_latency", "seconds": "x"},  # non-numeric
+        {"ts": 1.3, "kind": "serve_latency", "seconds": True},  # bool
+        {"kind": "ingest", "points": 8},
+        {"kind": "ingest"},                                  # no points
+        {"kind": "ingest", "points": "many"},                # non-numeric
+    ]
+    out = sm.summarize(events)
+    assert "== serve latency ==" in out  # the one good event survives
+    assert "== ingest ==" in out
+    # exactly one good event each: counts say 1
+    lat_row = out.split("== serve latency ==")[1].splitlines()[3]
+    assert lat_row.strip().startswith("1")
