@@ -62,32 +62,32 @@ SPARK_TREE_POINTS_PER_SEC = 2000 * 1000 / 616.87
 # One full LAL query (classes/RESULTS.txt:20, TOTAL TIME).
 SPARK_LAL_QUERY_SEC = 1654.16
 
-# Per-chip bf16 peak FLOP/s by jax device_kind (public spec sheets).
-_PEAK_BF16 = {
-    "TPU v2": 45e12,
-    "TPU v3": 123e12,
-    "TPU v4": 275e12,
-    "TPU v5 lite": 197e12,
-    "TPU v5e": 197e12,
-    "TPU v5": 459e12,
-    "TPU v5p": 459e12,
-    "TPU v6 lite": 918e12,
-    "TPU v6e": 918e12,
-}
-
-
 def _peak_flops():
-    import jax
+    # The chip tables moved to analysis/roofline.py (the roofline attribution
+    # layer needs them next to the bandwidth table); this shim keeps every
+    # bench call site and its (peak, kind) contract unchanged.
+    from distributed_active_learning_tpu.analysis.roofline import peak_flops
 
-    kind = jax.devices()[0].device_kind
-    for name, peak in _PEAK_BF16.items():
-        if kind.startswith(name):
-            return peak, kind
-    return None, kind
+    return peak_flops()
 
 
-def _median_time(fn, iters):
+def _flight(kind: str, **fields) -> None:
+    """Record into the flight recorder when one is installed (bench installs
+    it in main(); the mode functions also run under pytest with no recorder —
+    then this is a cheap no-op)."""
+    try:
+        from distributed_active_learning_tpu.runtime.telemetry import flight_record
+    except Exception:
+        return
+    flight_record(kind, **fields)
+
+
+def _median_time(fn, iters, label=None):
     """Median wall time of ``fn`` (each fn must end in a device sync).
+
+    ``label`` names the timed program in the flight recorder — a SIGTERMed
+    bench's artifact then says which launch was in flight, not just which
+    mode (the r05 post-mortem gap).
 
     Methodology note for the tunnel-attached chip: block_until_ready can
     return early for SMALL programs there (async completion — measured: a
@@ -98,15 +98,19 @@ def _median_time(fn, iters):
     ~100 ms per-program sync latency to every sample (see
     ops/trees_train.py docstring), overstating small kernels the other way.
     """
+    if label:
+        _flight("bench_timing_start", label=label, iters=iters)
     times = []
     for _ in range(iters):
         t0 = time.perf_counter()
         fn()
         times.append(time.perf_counter() - t0)
+    if label:
+        _flight("bench_timing_end", label=label, seconds=round(sum(times), 4))
     return float(np.median(times))
 
 
-def _device_time_per_call(enqueue, lo=None, hi=None, samples=None):
+def _device_time_per_call(enqueue, lo=None, hi=None, samples=None, label=None):
     """Per-call DEVICE time via differential batching: enqueue ``b`` calls,
     sync once, and take ``(wall(hi) - wall(lo)) / (hi - lo)`` — the rig's
     fixed per-sync latency cancels. ``enqueue()`` must return its async
@@ -133,6 +137,8 @@ def _device_time_per_call(enqueue, lo=None, hi=None, samples=None):
     lo = (2 if on_tpu else 1) if lo is None else lo
     hi = (12 if on_tpu else 3) if hi is None else hi
     samples = (3 if on_tpu else 1) if samples is None else samples
+    if label:
+        _flight("bench_timing_start", label=label, lo=lo, hi=hi)
 
     def batch_wall(b):
         t0 = time.perf_counter()
@@ -282,16 +288,18 @@ def bench_score(args):
         out = acquisition(forest, pool_dev, unlabeled)
         jax.block_until_ready(out)
 
+    _flight("bench_compile", label="score/acquisition")
     run()  # compile
     # Median, like every other mode (r3 used min here — best-case vs the
     # typical-case numbers elsewhere was inconsistent methodology).
-    wall_sec = _median_time(run, args.iters)
+    wall_sec = _median_time(run, args.iters, label="score/acquisition")
     # Device throughput: the sustainable rate of the kernel itself, with the
     # rig's ~90 ms per-sync latency cancelled out (see _device_time_per_call).
     # The wall number stays in the JSON — it is what one synced query costs
     # end-to-end on this rig.
     device_sec, device_method = _device_time_per_call(
-        lambda: acquisition(forest, pool_dev, unlabeled)
+        lambda: acquisition(forest, pool_dev, unlabeled),
+        label="score/acquisition_device",
     )
     scores_per_sec = args.pool / device_sec
 
@@ -457,10 +465,12 @@ def bench_round(args):
     def run_device():
         jax.block_until_ready(device_round(binned.codes, y_dev, mask_dev, key))
 
+    _flight("bench_compile", label="round/device_round")
     run_device()  # compile
-    device_sec = _median_time(run_device, args.iters)
+    device_sec = _median_time(run_device, args.iters, label="round/device_round")
     round_dev_sec, round_dev_method = _device_time_per_call(
-        lambda: device_round(binned.codes, y_dev, mask_dev, key)
+        lambda: device_round(binned.codes, y_dev, mask_dev, key),
+        label="round/device_round_device",
     )
 
     # Phase split: time the fit and the score/select as separate programs so
@@ -471,8 +481,9 @@ def bench_round(args):
     def run_fit():
         jax.block_until_ready(device_fit_only(binned.codes, y_dev, mask_dev, key))
 
+    _flight("bench_compile", label="round/fit")
     run_fit()  # compile
-    fit_sec = _median_time(run_fit, args.iters)
+    fit_sec = _median_time(run_fit, args.iters, label="round/fit")
 
     # --- host (sklearn) fit round: the round-2 status quo, for comparison.
     def run_host():
@@ -482,7 +493,7 @@ def bench_round(args):
         jax.block_until_ready(score_select(forest, pool_dev, mask_dev))
 
     run_host()  # compile
-    host_sec = _median_time(run_host, max(args.iters // 2, 1))
+    host_sec = _median_time(run_host, max(args.iters // 2, 1), label="round/host_fit")
 
     spark_round_sec = args.pool * args.trees / SPARK_TREE_POINTS_PER_SEC
     result = {
@@ -496,8 +507,77 @@ def bench_round(args):
         "vs_baseline_device": round(spark_round_sec / round_dev_sec, 1),
         "spark_round_seconds_derived": round(spark_round_sec, 1),
     }
+    # Roofline attribution (the observability tentpole): price the round's
+    # programs with XLA's own cost model (compiled.cost_analysis, via
+    # analysis/roofline.py) and join the measured device seconds — achieved
+    # FLOP/s, achieved bandwidth, MFU, and a compute-vs-bandwidth bound
+    # verdict land next to every wall number, so the next BENCH_r* names the
+    # bottleneck instead of just the throughput. Priced OUTSIDE the timed
+    # sections (the AOT lower().compile() path pays one extra compile).
+    result["roofline"] = _roofline_round(
+        device_fit_only, device_round, (binned.codes, y_dev, mask_dev, key),
+        fit_sec=fit_sec, round_sec=round_dev_sec,
+        score_sec=max(device_sec - fit_sec, 0.0),
+        round_method=round_dev_method,
+    )
     result.update(_bench_scan_fusion(args, pool, pool_y, mask0, binned))
+    # the fused chunk's entry comes back from the scan-fusion bench, where
+    # the chunk program lives; fold it into the per-phase roofline section
+    chunk_roof = result.pop("roofline_chunk", None)
+    if chunk_roof is not None and isinstance(result["roofline"], dict):
+        result["roofline"]["chunk"] = chunk_roof
     return result
+
+
+def _roofline_round(
+    fit_fn, round_fn, fargs, fit_sec, round_sec, score_sec,
+    round_method="differential",
+):
+    """Per-phase roofline table for round mode: the fit program, the full
+    fused round, and the score/select half (derived as round minus fit —
+    it has no standalone program; XLA fuses it against the fit's traced
+    forest, so the subtraction is an upper bound on its true cost).
+
+    The phases join DIFFERENT time bases — fit/score_select carry the wall
+    medians the bench already measures, round the differential device time —
+    so every row names its basis under ``time_method``; on the tunnel rig
+    (~100 ms per-sync latency) a wall-based row understates achieved rates
+    for small programs and must not be ranked against a differential row.
+    """
+    from distributed_active_learning_tpu.analysis import roofline as roofline_lib
+
+    try:
+        fit_cost = roofline_lib.program_cost(fit_fn, *fargs)
+        round_cost = roofline_lib.program_cost(round_fn, *fargs)
+    except Exception as e:  # noqa: BLE001 — attribution must not kill a bench
+        return {"error": f"{type(e).__name__}: {e}"}
+    out = {
+        "fit": roofline_lib.attribute(fit_cost, fit_sec),
+        "round": roofline_lib.attribute(round_cost, round_sec),
+    }
+    out["fit"]["time_method"] = "wall_median"
+    out["round"]["time_method"] = round_method
+    if round_cost.get("flops") and fit_cost.get("flops"):
+        flops = max(round_cost["flops"] - fit_cost["flops"], 0.0) or None
+        nbytes = None
+        if round_cost.get("bytes_accessed") and fit_cost.get("bytes_accessed"):
+            nbytes = (
+                max(round_cost["bytes_accessed"] - fit_cost["bytes_accessed"], 0.0)
+                or None
+            )
+        score_cost = {
+            "flops": flops,
+            "bytes_accessed": nbytes,
+            "flops_per_byte": (
+                round(flops / nbytes, 4) if flops and nbytes else None
+            ),
+        }
+        out["score_select"] = roofline_lib.attribute(
+            score_cost, score_sec if score_sec > 0 else None
+        )
+        out["score_select"]["derived"] = "round - fit"
+        out["score_select"]["time_method"] = "derived_wall"
+    return out
 
 
 def _bench_scan_fusion(args, pool, pool_y, mask0, binned):
@@ -590,13 +670,16 @@ def _bench_scan_fusion(args, pool, pool_y, mask0, binned):
     # regressions visible next to the steady-state numbers they pollute.
     from distributed_active_learning_tpu.runtime import telemetry
 
+    _flight("bench_compile", label="round/chunk_scan")
     t0 = time.perf_counter()
     run_chunked()   # compile
     chunk_first_call = time.perf_counter() - t0
     run_per_round() # compile
     reps = max(min(args.iters, 5), 2)
-    chunk_sec = _median_time(run_chunked, reps) / K
-    per_round_sec = _median_time(run_per_round, reps) / K
+    chunk_sec = _median_time(run_chunked, reps, label="round/chunk_scan") / K
+    per_round_sec = _median_time(
+        run_per_round, reps, label="round/per_round_driver"
+    ) / K
     out = {
         "rounds_per_launch": K,
         "scan_seconds_per_round": round(chunk_sec, 4),
@@ -609,6 +692,21 @@ def _bench_scan_fusion(args, pool, pool_y, mask0, binned):
         ),
         "chunk_jit_cache_entries": telemetry.jit_cache_size(chunk_fn),
     }
+    # The fused chunk's roofline entry: one launch covers K rounds, so the
+    # static cost joins the PER-LAUNCH seconds (chunk_sec * K); bench_round
+    # folds this into its per-phase "roofline" section as "chunk".
+    from distributed_active_learning_tpu.analysis import roofline as roofline_lib
+
+    try:
+        chunk_cost = roofline_lib.program_cost(
+            chunk_fn, binned.codes, state0, aux, fit_key, tx, ty, end_round
+        )
+        chunk_attr = roofline_lib.attribute(chunk_cost, chunk_sec * K)
+        chunk_attr["rounds_per_launch"] = K
+        chunk_attr["time_method"] = "wall_median_per_launch"
+        out["roofline_chunk"] = chunk_attr
+    except Exception as e:  # noqa: BLE001 — attribution must not kill a bench
+        out["roofline_chunk"] = {"error": f"{type(e).__name__}: {e}"}
     out.update(_bench_pipelined(args, chunk_fn, state0, aux, binned, fit_key,
                                 tx, ty, K, window))
     out.update(telemetry.device_memory_gauges())
@@ -1084,6 +1182,16 @@ def bench_neural(args):
     }
 
 
+def _run_bench(name, fn, args):
+    """One bench mode under flight-recorder mode markers: a SIGTERMed run's
+    artifact shows a ``bench_mode_start`` with no matching ``bench_mode_end``
+    — the in-flight mode, by name."""
+    _flight("bench_mode_start", mode=name)
+    r = fn(args)
+    _flight("bench_mode_end", mode=name)
+    return r
+
+
 def _run_mode(args) -> dict:
     """Execute the selected mode(s); returns the JSON payload (no health keys).
 
@@ -1092,7 +1200,7 @@ def _run_mode(args) -> dict:
     driver-captured artifact instead of living only in the README (r4 weak #6).
     """
     if args.mode == "score":
-        r = bench_score(args)
+        r = _run_bench("score", bench_score, args)
         return {
             "metric": "acquisition_scores_per_sec",
             "value": r["value"],
@@ -1101,7 +1209,7 @@ def _run_mode(args) -> dict:
             **{k: v for k, v in r.items() if k not in ("value", "vs_baseline", "kernel")},
         }
     if args.mode == "density":
-        r = bench_density(args)
+        r = _run_bench("density", bench_density, args)
         return {
             "metric": "density_scores_per_sec",
             "value": r["density_scores_per_sec"],
@@ -1110,7 +1218,7 @@ def _run_mode(args) -> dict:
             "density_time_method": r["density_time_method"],
         }
     if args.mode == "neural":
-        r = bench_neural(args)
+        r = _run_bench("neural", bench_neural, args)
         return {
             "metric": "neural_round_seconds",
             "value": r["cnn_round_seconds"],
@@ -1119,7 +1227,7 @@ def _run_mode(args) -> dict:
             **{k: v for k, v in r.items() if k != "cnn_round_seconds"},
         }
     if args.mode == "sweep":
-        r = bench_sweep(args)
+        r = _run_bench("sweep", bench_sweep, args)
         return {
             "metric": "sweep_experiments_rounds_per_second",
             "value": r["sweep_experiments_rounds_per_second"],
@@ -1134,7 +1242,7 @@ def _run_mode(args) -> dict:
             **r,
         }
     if args.mode == "serve":
-        r = bench_serve(args)
+        r = _run_bench("serve", bench_serve, args)
         return {
             "metric": "serve_qps",
             "value": r["serve_qps"],
@@ -1149,7 +1257,7 @@ def _run_mode(args) -> dict:
             **r,
         }
     if args.mode == "round":
-        r = bench_round(args)
+        r = _run_bench("round", bench_round, args)
         return {
             "metric": "al_round_seconds",
             "value": r["round_seconds"],
@@ -1158,7 +1266,7 @@ def _run_mode(args) -> dict:
             **{k: v for k, v in r.items() if k not in ("round_seconds", "vs_baseline")},
         }
     if args.mode == "lal":
-        r = bench_lal(args)
+        r = _run_bench("lal", bench_lal, args)
         return {
             "metric": "lal_query_seconds",
             "value": r["lal_query_seconds"],
@@ -1180,8 +1288,10 @@ def _run_mode(args) -> dict:
     # is skipped up front — the between-modes check alone let a 4-minute
     # neural compile start at deadline-minus-epsilon and blow the outer
     # timeout anyway. On TPU the modes run in seconds, so no pre-estimates.
+    # round includes the roofline pricing compiles (device_round, fit, chunk
+    # through the AOT path) on top of the timing bodies.
     _cpu_cost = {
-        "score": 30, "density": 25, "round": 220, "sweep": 90, "serve": 120,
+        "score": 30, "density": 25, "round": 280, "sweep": 90, "serve": 120,
         "lal": 30, "neural": 260,
     }
 
@@ -1191,8 +1301,26 @@ def _run_mode(args) -> dict:
         import jax
 
         est = _cpu_cost.get(name, 0) if jax.default_backend() != "tpu" else 0
-        if time.perf_counter() - t0 + est > deadline:
-            skipped.append(name)
+        elapsed = time.perf_counter() - t0
+        if elapsed + est > deadline:
+            # Structured skip record (was a bare mode-name list): the artifact
+            # says WHY each mode is missing and how much budget was left when
+            # the decision fell — and the flight recorder mirrors it, so a
+            # later kill's post-mortem carries the same story.
+            reason = (
+                "deadline_exceeded" if elapsed > deadline
+                else "predicted_overrun"
+            )
+            entry = {
+                "mode": name,
+                "reason": reason,
+                "elapsed_at_skip_seconds": round(elapsed, 2),
+                "deadline_seconds": deadline,
+            }
+            if reason == "predicted_overrun":
+                entry["estimated_mode_seconds"] = est
+            skipped.append(entry)
+            _flight("bench_mode_skip", **entry)
             return False
         return True
 
@@ -1202,7 +1330,7 @@ def _run_mode(args) -> dict:
     out = _PARTIAL
     out.clear()
     if want("score"):
-        s = bench_score(args)
+        s = _run_bench("score", bench_score, args)
         out.update({
             "metric": "acquisition_scores_per_sec",
             "value": s["value"],
@@ -1218,13 +1346,13 @@ def _run_mode(args) -> dict:
             "wall_scores_per_sec": s["wall_scores_per_sec"],
         })
     if want("density"):
-        d = bench_density(args)
+        d = _run_bench("density", bench_density, args)
         out.update({
             "density_scores_per_sec": d["density_scores_per_sec"],
             "density_time_method": d["density_time_method"],
         })
     if want("round"):
-        rd = bench_round(args)
+        rd = _run_bench("round", bench_round, args)
         out.update({
             "round_seconds": rd["round_seconds"],
             "round_device_seconds": rd["round_device_seconds"],
@@ -1249,17 +1377,19 @@ def _run_mode(args) -> dict:
             "pipeline_speedup": rd["pipeline_speedup"],
             "touchdown_hidden_fraction": rd["touchdown_hidden_fraction"],
             "overlap_seconds": rd["overlap_seconds"],
+            # Per-phase roofline attribution (fit/score/round/chunk).
+            "roofline": rd.get("roofline"),
             # Memory watermarks ride only when the backend reports them (TPU).
             **{k: v for k, v in rd.items() if k.startswith("device_")},
         })
     if want("sweep"):
-        sw = bench_sweep(args)
+        sw = _run_bench("sweep", bench_sweep, args)
         out.update(sw)
     if want("serve"):
-        sv = bench_serve(args)
+        sv = _run_bench("serve", bench_serve, args)
         out.update(sv)
     if want("lal"):
-        ll = bench_lal(args)
+        ll = _run_bench("lal", bench_lal, args)
         out.update({
             "lal_query_seconds": ll["lal_query_seconds"],
             "lal_query_device_seconds": ll["lal_query_device_seconds"],
@@ -1268,7 +1398,7 @@ def _run_mode(args) -> dict:
             "lal_query_vs_spark_device": ll["vs_baseline_device"],
         })
     if want("neural"):
-        nn = bench_neural(args)
+        nn = _run_bench("neural", bench_neural, args)
         out.update({
             "cnn_round_seconds": nn["cnn_round_seconds"],
             "cnn_time_method": nn["cnn_time_method"],
@@ -1509,6 +1639,25 @@ def main():
         "named at PR time instead of surfacing as a mystery MFU drop",
     )
     ap.add_argument(
+        "--compare-to", default=None, metavar="PATH",
+        help="regression sentinel (benches/compare_bench.py): diff this "
+        "run's payload against a baseline bench JSON (raw payload or a "
+        "driver-captured BENCH_r*.json wrapper) with per-metric thresholds; "
+        "the named verdict and fired thresholds ride the output JSON under "
+        "'regression' (the bench itself never fails on a regression — "
+        "gate with compare_bench.py directly)",
+    )
+    ap.add_argument(
+        "--flight-recorder", default=None, metavar="PATH",
+        help="launch flight recorder artifact path (default: the "
+        "DAL_FLIGHT_RECORDER env var, else flight_recorder.json next to "
+        "the cwd; empty string disables). A bounded in-process ring of "
+        "mode/launch/timing events, dumped as one JSON artifact on SIGTERM/"
+        "SIGINT, unhandled crash, SIGUSR1, and deadline skips — a dead run "
+        "(BENCH_r05: rc 124, parsed null) leaves a trace of what it was "
+        "doing",
+    )
+    ap.add_argument(
         "--deadline", type=float, default=None,
         help="wall-seconds budget for --mode all: once exceeded, remaining "
         "modes are skipped (recorded under modes_skipped) and the JSON for "
@@ -1544,6 +1693,32 @@ def main():
     cpu_sizes = False
     audit_summary = None
     try:
+        # The flight recorder arms AFTER the signal handler above: its
+        # SIGTERM hook dumps the ring and then CHAINS to _interrupted, so a
+        # kill both leaves the artifact and unwinds through the JSON
+        # printer. (Importing telemetry pulls in jax — that is why this sits
+        # inside the try, where the clock is already running.)
+        if args.flight_recorder is None:
+            args.flight_recorder = os.environ.get(
+                "DAL_FLIGHT_RECORDER", "flight_recorder.json"
+            )
+        if args.flight_recorder:
+            from distributed_active_learning_tpu.runtime.telemetry import (
+                install_flight_recorder,
+            )
+
+            install_flight_recorder(args.flight_recorder)
+            _flight("bench_start", mode=args.mode, deadline=args.deadline)
+            # stderr marker = "dump triggers armed": the SIGTERM subprocess
+            # test (and an operator watching a live run) can start probing
+            # with SIGUSR1 only once this line appears — before it, USR1
+            # still carries its default terminate disposition.
+            import sys
+
+            print(
+                f"# flight recorder armed: {args.flight_recorder}",
+                file=sys.stderr, flush=True,
+            )
         cpu_sizes = _resolve_sizes(args)
         if args.audit:
             audit_summary = _audit_gate()
@@ -1571,12 +1746,58 @@ def main():
         payload.setdefault("metric", "bench_interrupted")
         payload.setdefault("value", None)
         rc = 0 if isinstance(e, BenchInterrupted) else 1
+        # The post-mortem artifact: the recorder's SIGTERM hook already
+        # dumped on a kill; this covers crashes (and re-dumps with the
+        # unwind reason appended — dump() keeps every reason seen).
+        _flight_dump(
+            "bench_interrupted" if isinstance(e, BenchInterrupted)
+            else f"crash:{type(e).__name__}"
+        )
     if cpu_sizes:
         payload["cpu_smoke_sizes"] = True
     if audit_summary is not None:
         payload["audit"] = audit_summary
+    if payload.get("modes_skipped"):
+        # Deadline skips are a soft failure mode worth a post-mortem too.
+        _flight_dump("deadline_skips")
+    if args.compare_to and "error" not in payload:
+        payload["regression"] = _compare_to(args.compare_to, payload)
     print(json.dumps(payload))
     raise SystemExit(rc)
+
+
+def _flight_dump(reason: str) -> None:
+    try:
+        from distributed_active_learning_tpu.runtime.telemetry import flight_dump
+
+        flight_dump(reason)
+    except Exception:
+        pass  # never let the post-mortem break the JSON print
+
+
+def _compare_to(baseline_path: str, payload: dict) -> dict:
+    """--compare-to: run the regression sentinel in-process and return its
+    JSON verdict (attached under 'regression'; errors degrade to a dict with
+    'error' — the bench's own artifact must always land)."""
+    import importlib.util
+
+    try:
+        path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "benches", "compare_bench.py",
+        )
+        spec = importlib.util.spec_from_file_location("compare_bench", path)
+        mod = importlib.util.module_from_spec(spec)
+        # register BEFORE exec: dataclasses resolves the module's string
+        # annotations through sys.modules[cls.__module__]
+        import sys
+
+        sys.modules["compare_bench"] = mod
+        spec.loader.exec_module(mod)
+        baseline = mod.load_payload(baseline_path)
+        return mod.compare_payloads(baseline, payload, baseline_name=baseline_path)
+    except BaseException as e:  # noqa: BLE001 — SystemExit from load included
+        return {"error": f"{type(e).__name__}: {e}"}
 
 
 if __name__ == "__main__":

@@ -1,0 +1,363 @@
+"""Bench-regression sentinel: diff two bench JSONs with per-metric thresholds.
+
+The perf trajectory regressed r03 -> r04 (2.1M scores/s at 14% MFU down to
+431k at 2.9%) and the artifacts recorded it without anyone — or anything —
+being forced to notice. This tool makes the diff a verdict:
+
+    python benches/compare_bench.py BENCH_r03.json BENCH_r04.json
+    python benches/compare_bench.py benches/baselines/cpu_smoke_round.json \
+        bench_smoke.json --warn-only
+
+Inputs are either raw ``python bench.py`` payloads or the driver-captured
+``BENCH_r*.json`` wrappers (the ``parsed`` key is unwrapped; a wrapper whose
+``parsed`` is null — BENCH_r05's rc-124 death — is a load error, named as
+such). Each known metric compares under its own direction and relative
+threshold; counters (``recompiles_after_warmup``) regress on ANY increase
+and are HARD by default — ``--warn-only`` downgrades timing regressions to
+warnings (rc 0) but hard regressions still fail, which is how the tier-1
+smoke gate runs it on CPU (timing there is noise; a silent recompile is
+not).
+
+Exit codes: 0 ok/improved (or soft regressions under --warn-only); non-zero
+for regressions and load/usage errors. ``--json`` prints the machine verdict;
+``--trajectory A.json B.json ...`` appends a cross-round trend table.
+
+stdlib-only on purpose: it must run anywhere a JSON landed, without jax.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+from typing import Dict, List, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class MetricSpec:
+    """One comparable metric: which way is good, and how much drift in the
+    bad direction fires. ``kind='counter'`` ignores ``rel_tol`` — any move
+    in the bad direction fires (recompiles are events, not noise)."""
+
+    key: str
+    direction: str        # "higher" | "lower" is better
+    rel_tol: float = 0.2  # fractional change tolerated in the bad direction
+    kind: str = "timing"  # "timing" | "counter"
+    hard: bool = False    # fails even under --warn-only
+
+
+#: The sentinel's vocabulary. Thresholds are deliberately loose for wall
+#: times (rig noise; the CPU CI runners doubly so) and tight for ratios the
+#: architecture guarantees (MFU, recompiles). --threshold KEY=FRACTION
+#: overrides per run.
+DEFAULT_SPECS: List[MetricSpec] = [
+    # headline scoring throughput + its roofline position
+    MetricSpec("value", "higher", 0.20),
+    MetricSpec("mfu", "higher", 0.20),
+    MetricSpec("achieved_tflops", "higher", 0.20),
+    MetricSpec("density_scores_per_sec", "higher", 0.25),
+    # round mode
+    MetricSpec("round_seconds", "lower", 0.30),
+    MetricSpec("round_device_seconds", "lower", 0.30),
+    MetricSpec("scan_seconds_per_round", "lower", 0.30),
+    MetricSpec("per_round_driver_seconds_per_round", "lower", 0.35),
+    MetricSpec("scan_fusion_speedup", "higher", 0.30),
+    MetricSpec("pipelined_seconds_per_round", "lower", 0.30),
+    MetricSpec("touchdown_hidden_fraction", "higher", 0.50),
+    # sweep / serve / lal / neural
+    MetricSpec("sweep_experiments_rounds_per_second", "higher", 0.30),
+    MetricSpec("sweep_speedup", "higher", 0.30),
+    MetricSpec("serve_qps", "higher", 0.30),
+    MetricSpec("serve_scores_per_sec", "higher", 0.30),
+    MetricSpec("serve_p50_ms", "lower", 0.40),
+    MetricSpec("serve_p99_ms", "lower", 0.50),
+    MetricSpec("ingest_points_per_sec", "higher", 0.30),
+    MetricSpec("lal_query_seconds", "lower", 0.30),
+    MetricSpec("lal_query_device_seconds", "lower", 0.30),
+    MetricSpec("cnn_round_seconds", "lower", 0.40),
+    MetricSpec("transformer_batchbald_round_seconds", "lower", 0.40),
+    # architectural counters: any increase is a fired invariant, not noise
+    MetricSpec("recompiles_after_warmup", "lower", 0.0, kind="counter", hard=True),
+    MetricSpec("chunk_jit_cache_entries", "lower", 0.0, kind="counter"),
+]
+
+#: "value" is mode-dependent; it only compares when both payloads agree on
+#: what it measures, under that metric's own direction.
+VALUE_DIRECTIONS = {
+    "acquisition_scores_per_sec": "higher",
+    "density_scores_per_sec": "higher",
+    "sweep_experiments_rounds_per_second": "higher",
+    "serve_qps": "higher",
+    "al_round_seconds": "lower",
+    "lal_query_seconds": "lower",
+    "neural_round_seconds": "lower",
+}
+
+
+def load_payload(path: str) -> dict:
+    """Read a bench JSON: a raw payload, a JSONL tail, or a driver
+    ``BENCH_r*.json`` wrapper (unwrapped via its ``parsed`` key). A wrapper
+    with ``parsed: null`` is the r05 failure shape — a named load error."""
+    with open(path) as f:
+        text = f.read().strip()
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        # maybe a log with the JSON on its last non-empty line
+        lines = [ln for ln in text.splitlines() if ln.strip()]
+        try:
+            doc = json.loads(lines[-1]) if lines else {}
+        except ValueError:
+            raise SystemExit(
+                f"{path}: neither the file nor its last line parses as JSON "
+                "— not a bench payload"
+            ) from None
+    if isinstance(doc, dict) and "parsed" in doc and ("rc" in doc or "cmd" in doc):
+        if doc["parsed"] is None:
+            raise SystemExit(
+                f"{path}: driver wrapper holds no parseable bench payload "
+                f"(rc={doc.get('rc')}) — the run died before printing JSON; "
+                "nothing to compare"
+            )
+        return doc["parsed"]
+    if not isinstance(doc, dict):
+        raise SystemExit(f"{path}: not a bench payload (top level is not an object)")
+    return doc
+
+
+def _num(v) -> Optional[float]:
+    return float(v) if isinstance(v, (int, float)) and not isinstance(v, bool) else None
+
+
+def _spec_table(
+    thresholds: Optional[Dict[str, float]] = None,
+    extra_hard: Optional[List[str]] = None,
+) -> List[MetricSpec]:
+    specs = []
+    for s in DEFAULT_SPECS:
+        tol = (thresholds or {}).get(s.key, s.rel_tol)
+        hard = s.hard or s.key in (extra_hard or [])
+        specs.append(dataclasses.replace(s, rel_tol=tol, hard=hard))
+    return specs
+
+
+def compare_payloads(
+    baseline: dict,
+    current: dict,
+    thresholds: Optional[Dict[str, float]] = None,
+    extra_hard: Optional[List[str]] = None,
+    baseline_name: str = "baseline",
+) -> dict:
+    """Diff two payloads; returns the JSON verdict document.
+
+    Findings cover every metric present (numerically) in BOTH payloads;
+    one-sided metrics are listed under ``skipped`` so a vanished key (a mode
+    that stopped running) is visible rather than silently uncompared.
+    """
+    findings, skipped, notes = [], [], []
+    if bool(baseline.get("cpu_smoke_sizes")) != bool(current.get("cpu_smoke_sizes")):
+        notes.append(
+            "size tables differ (cpu_smoke_sizes mismatch): one side ran "
+            "smoke shapes, the other rig shapes — treat timing diffs as "
+            "incomparable"
+        )
+    for flag_side, payload in (("baseline", baseline), ("current", current)):
+        if payload.get("degraded_rig"):
+            notes.append(
+                f"{flag_side} run flagged degraded_rig — its numbers may "
+                "reflect the rig, not the code"
+            )
+    for spec in _spec_table(thresholds, extra_hard):
+        b, c = _num(baseline.get(spec.key)), _num(current.get(spec.key))
+        direction = spec.direction
+        if spec.key == "value":
+            bm, cm = baseline.get("metric"), current.get("metric")
+            if bm != cm:
+                skipped.append({"metric": "value", "reason": f"metric differs ({bm} vs {cm})"})
+                continue
+            direction = VALUE_DIRECTIONS.get(bm, "higher")
+        if b is None and c is None:
+            continue
+        if b is None or c is None:
+            skipped.append({
+                "metric": spec.key,
+                "reason": f"missing in {'baseline' if b is None else 'current'}",
+            })
+            continue
+        if spec.kind == "counter":
+            bad = c > b if direction == "lower" else c < b
+            rel = None if b == 0 else (c - b) / abs(b)
+            status = "regression" if bad else ("ok" if c == b else "improvement")
+        else:
+            if b == 0:
+                skipped.append({"metric": spec.key, "reason": "baseline is zero"})
+                continue
+            rel = (c - b) / abs(b)
+            worse = rel < -spec.rel_tol if direction == "higher" else rel > spec.rel_tol
+            better = rel > spec.rel_tol if direction == "higher" else rel < -spec.rel_tol
+            status = "regression" if worse else ("improvement" if better else "ok")
+        findings.append({
+            "metric": spec.key if spec.key != "value" else f"value({current.get('metric')})",
+            "baseline": b,
+            "current": c,
+            "change_pct": round(rel * 100, 1) if rel is not None else None,
+            "threshold_pct": (
+                round(spec.rel_tol * 100, 1) if spec.kind == "timing"
+                else "any-increase" if direction == "lower" else "any-decrease"
+            ),
+            "direction": f"{direction}-is-better",
+            "status": status,
+            "hard": spec.hard,
+        })
+    regressions = [f for f in findings if f["status"] == "regression"]
+    hard_regressions = [f for f in regressions if f["hard"]]
+    improvements = [f for f in findings if f["status"] == "improvement"]
+    if regressions:
+        # the verdict NAMES the worst offender: most threshold-normalized
+        # exceedance first, hard counters always outrank soft timings
+        def _badness(f):
+            pct, thr = f["change_pct"], f["threshold_pct"]
+            over = abs(pct) / thr if isinstance(thr, (int, float)) and thr else float("inf")
+            return (f["hard"], over)
+
+        worst = max(regressions, key=_badness)
+        verdict = f"regression:{worst['metric']}"
+    elif improvements and not regressions:
+        verdict = "improved"
+    else:
+        verdict = "ok"
+    return {
+        "schema": 1,
+        "baseline": baseline_name,
+        "verdict": verdict,
+        "regressions": [f["metric"] for f in regressions],
+        "hard_regressions": [f["metric"] for f in hard_regressions],
+        "improvements": [f["metric"] for f in improvements],
+        "notes": notes,
+        "findings": findings,
+        "skipped": skipped,
+    }
+
+
+def render(report: dict) -> str:
+    lines = []
+    for note in report["notes"]:
+        lines.append(f"note: {note}")
+    for f in report["findings"]:
+        tag = {"regression": "REGRESSION", "improvement": "improved  ",
+               "ok": "ok        "}[f["status"]]
+        hard = " [hard]" if f["hard"] and f["status"] == "regression" else ""
+        pct = f"{f['change_pct']:+.1f}%" if f["change_pct"] is not None else "n/a"
+        thr = (
+            f"{f['threshold_pct']}%" if isinstance(f["threshold_pct"], (int, float))
+            else f["threshold_pct"]
+        )
+        lines.append(
+            f"{tag}{hard} {f['metric']}: {f['baseline']} -> {f['current']} "
+            f"({pct}; allowed {thr}, {f['direction']})"
+        )
+    for s in report["skipped"]:
+        lines.append(f"skipped    {s['metric']}: {s['reason']}")
+    lines.append(
+        f"verdict: {report['verdict']} "
+        f"({len(report['regressions'])} regressions "
+        f"[{len(report['hard_regressions'])} hard], "
+        f"{len(report['improvements'])} improvements)"
+    )
+    return "\n".join(lines)
+
+
+def render_trajectory(paths: List[str]) -> str:
+    """Cross-round trend of the headline metrics over BENCH_r*-style files
+    (rows in the given order; unparseable artifacts show as dead rows rather
+    than disappearing)."""
+    cols = ("file", "metric", "value", "mfu", "round_seconds", "serve_p99_ms")
+    rows = []
+    for path in paths:
+        try:
+            p = load_payload(path)
+            rows.append([
+                path.rsplit("/", 1)[-1], str(p.get("metric", "?")),
+                str(p.get("value")), str(p.get("mfu")),
+                str(p.get("round_seconds")), str(p.get("serve_p99_ms")),
+            ])
+        except (SystemExit, OSError, ValueError) as e:
+            rows.append([path.rsplit("/", 1)[-1], f"(unparseable: {e})", "", "", "", ""])
+    widths = [max(len(cols[i]), *(len(r[i]) for r in rows)) for i in range(len(cols))]
+
+    def _row(cells):
+        return "  ".join(str(c).ljust(w) for c, w in zip(cells, widths))
+
+    return "\n".join(
+        [_row(cols), _row(["-" * w for w in widths])] + [_row(r) for r in rows]
+    )
+
+
+def _parse_threshold(pair: str):
+    if "=" not in pair:
+        raise argparse.ArgumentTypeError(f"--threshold needs KEY=FRACTION, got {pair!r}")
+    k, v = pair.split("=", 1)
+    return k, float(v)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Diff two bench JSONs with per-metric regression thresholds"
+    )
+    ap.add_argument("baseline", help="baseline bench JSON (raw or BENCH_r* wrapper)")
+    ap.add_argument("current", help="fresh bench JSON to judge")
+    ap.add_argument("--json", action="store_true", help="machine-readable verdict")
+    ap.add_argument(
+        "--warn-only", action="store_true",
+        help="soft (timing) regressions exit 0 with a warning; HARD metrics "
+        "(recompiles_after_warmup, --hard additions) still exit 1 — the CI "
+        "setting for noisy CPU runners",
+    )
+    ap.add_argument(
+        "--hard", action="append", default=[], metavar="KEY",
+        help="treat KEY as a hard metric (repeatable)",
+    )
+    ap.add_argument(
+        "--threshold", action="append", default=[], metavar="KEY=FRACTION",
+        type=_parse_threshold,
+        help="override a metric's relative threshold, e.g. mfu=0.1",
+    )
+    ap.add_argument(
+        "--trajectory", nargs="*", default=None, metavar="PATH",
+        help="also print a trend table over these bench artifacts "
+        "(e.g. BENCH_r0*.json)",
+    )
+    args = ap.parse_args(argv)
+
+    report = compare_payloads(
+        load_payload(args.baseline),
+        load_payload(args.current),
+        thresholds=dict(args.threshold),
+        extra_hard=args.hard,
+        baseline_name=args.baseline,
+    )
+    if args.json:
+        print(json.dumps(report))
+    else:
+        print(render(report))
+    if args.trajectory:
+        print("\n== trajectory ==")
+        print(render_trajectory(args.trajectory))
+
+    if report["hard_regressions"]:
+        return 1
+    if report["regressions"]:
+        if args.warn_only:
+            print(
+                f"warning: soft regressions under --warn-only: "
+                f"{', '.join(report['regressions'])}",
+                file=sys.stderr,
+            )
+            return 0
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

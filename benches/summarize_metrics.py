@@ -15,6 +15,11 @@ Sections:
   per-round driver recorded them, the table the reference printed;
 - **launches** — compile-vs-execute split of the scan-fused chunk program and
   any recompiles the jit cache detected;
+- **serve latency** — per-query percentiles, broken down by the concurrent
+  CAUSE each query was tagged with (slab_growth_compile / refit_dispatch /
+  none) so the service's p99 spike is attributable;
+- **roofline** — per-program cost attribution events (run.py --roofline):
+  flops/bytes, achieved rates, MFU, bound verdict;
 - **counters / gauges** — host transfer bytes, device memory watermarks.
 """
 
@@ -234,33 +239,52 @@ def summarize(events: List[dict]) -> str:
     # parser above — a malformed event (missing/non-numeric fields) is
     # skipped, never a crash: these streams come from long-running services
     # whose tails may be torn mid-line rewrites.
-    serve_secs = sorted(
-        float(e["seconds"])
-        for e in events
+    serve_events = [
+        e for e in events
         if e.get("kind") == "serve_latency"
         and isinstance(e.get("seconds"), (int, float))
         and not isinstance(e.get("seconds"), bool)
-    )
-    if serve_secs:
-        def _pct(q: float) -> str:
-            i = min(int(q * len(serve_secs)), len(serve_secs) - 1)
-            return f"{serve_secs[i] * 1e3:.3f}"
-
+    ]
+    if serve_events:
         ts = [
-            e["ts"] for e in events
-            if e.get("kind") == "serve_latency"
-            and isinstance(e.get("ts"), (int, float))
+            e["ts"] for e in serve_events
+            if isinstance(e.get("ts"), (int, float))
         ]
         span = (max(ts) - min(ts)) if len(ts) > 1 else 0.0
-        qps = f"{len(serve_secs) / span:.2f}" if span > 0 else "-"
+        qps = f"{len(serve_events) / span:.2f}" if span > 0 else "-"
+
+        def _lat_row(label, evs, with_qps="-"):
+            secs = sorted(float(e["seconds"]) for e in evs)
+
+            def _pct(q):
+                return f"{secs[min(int(q * len(secs)), len(secs) - 1)] * 1e3:.3f}"
+
+            return [
+                label, len(secs), _pct(0.50), _pct(0.90), _pct(0.99),
+                f"{secs[-1] * 1e3:.3f}", with_qps,
+            ]
+
+        # Per-cause breakdown (serving/service.py tags every query with the
+        # concurrent cause: slab_growth_compile / refit_dispatch / none) —
+        # the p99 spike is attributable instead of anonymous. Pre-cause
+        # streams land under "(untagged)".
+        rows = [_lat_row("all", serve_events, qps)]
+        causes = sorted(
+            {str(e.get("cause", "(untagged)")) for e in serve_events}
+        )
+        if causes != ["(untagged)"]:
+            for cause in causes:
+                evs = [
+                    e for e in serve_events
+                    if str(e.get("cause", "(untagged)")) == cause
+                ]
+                rows.append(_lat_row(cause, evs))
         out.append(
             "\n== serve latency ==\n"
             + _table(
-                ["queries", "p50 ms", "p90 ms", "p99 ms", "max ms", "qps"],
-                [[
-                    len(serve_secs), _pct(0.50), _pct(0.90), _pct(0.99),
-                    f"{serve_secs[-1] * 1e3:.3f}", qps,
-                ]],
+                ["cause", "queries", "p50 ms", "p90 ms", "p99 ms", "max ms",
+                 "qps"],
+                rows,
             )
         )
 
@@ -295,6 +319,35 @@ def summarize(events: List[dict]) -> str:
             + f"{len(refits)} drift-dispatched chunk launches ("
             + ", ".join(f"{r}={n}" for r, n in sorted(by_reason.items()))
             + ")"
+        )
+
+    rooflines = [e for e in events if e.get("kind") == "roofline"]
+    if rooflines:
+        rows = []
+        for e in rooflines:
+            if "error" in e:
+                rows.append([e.get("program", "?"), "(error)", e["error"][:40],
+                             "", "", "", ""])
+                continue
+
+            def _n(key, scale=1.0, nd=2):
+                v = e.get(key)
+                return f"{v * scale:.{nd}f}" if isinstance(v, (int, float)) else "-"
+
+            rows.append([
+                e.get("program", "?"),
+                _n("flops", 1e-9, 3), _n("bytes_accessed", 1e-9, 3),
+                _n("achieved_gflops_per_sec"), _n("achieved_gbytes_per_sec"),
+                _n("mfu", 100.0) + "%" if e.get("mfu") is not None else "-",
+                str(e.get("bound", "-")),
+            ])
+        out.append(
+            "\n== roofline ==\n"
+            + _table(
+                ["program", "gflops", "gbytes", "GFLOP/s", "GB/s", "mfu",
+                 "bound"],
+                rows,
+            )
         )
 
     streamed = [e for e in events if e.get("kind") == "round_stream"]

@@ -14,7 +14,12 @@ statically, at PR time:
   registry (:mod:`analysis.rules`);
 - :mod:`analysis.lint` AST-scans ``runtime/`` and ``strategies/`` for
   host-sync and retrace hazards the trace can't see;
-- :mod:`analysis.report` renders both as JSON (the CI gate) or a table.
+- :mod:`analysis.report` renders both as JSON (the CI gate) or a table;
+- :mod:`analysis.roofline` prices each program with XLA's own cost model
+  (``compiled.cost_analysis()``) and joins measured seconds into roofline
+  attribution — achieved FLOP/s, bandwidth, MFU, bound verdict (surfaced as
+  ``--costs``, the ``bench.py --mode round`` roofline section, and
+  ``run.py --roofline``).
 
 Entry points: ``python -m distributed_active_learning_tpu.analysis``,
 ``run.py --audit``, ``bench.py --audit``.
@@ -40,4 +45,11 @@ from distributed_active_learning_tpu.analysis.programs import (  # noqa: F401
 from distributed_active_learning_tpu.analysis.lint import (  # noqa: F401
     default_lint_targets,
     lint_paths,
+)
+from distributed_active_learning_tpu.analysis.roofline import (  # noqa: F401
+    attribute,
+    cost_table,
+    peak_bandwidth,
+    peak_flops,
+    program_cost,
 )

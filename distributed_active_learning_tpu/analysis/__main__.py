@@ -75,6 +75,15 @@ def build_parser() -> argparse.ArgumentParser:
         help="skip the jaxpr audit (lint only; no jax tracing)",
     )
     ap.add_argument(
+        "--costs", action="store_true",
+        help="price each registered program with XLA's cost model "
+        "(analysis/roofline.py: lower+compile, then compiled.cost_analysis) "
+        "and print the static per-program cost table — flops, bytes "
+        "accessed, arithmetic intensity. Unlike the audit this COMPILES "
+        "every selected program; filter with --kinds/--strategies for a "
+        "quick look",
+    )
+    ap.add_argument(
         "--list", action="store_true", help="list auditable programs and exit"
     )
     ap.add_argument(
@@ -109,6 +118,21 @@ def main(argv=None) -> int:
     if args.list:
         for spec in specs:
             print(spec.name)
+        return 0
+
+    if args.costs:
+        import json
+
+        from distributed_active_learning_tpu.analysis.roofline import (
+            cost_table,
+            render_cost_table,
+        )
+
+        table = cost_table(specs)
+        if args.json:
+            print(json.dumps({"schema": 1, "costs": table}))
+        else:
+            print(render_cost_table(table))
         return 0
 
     if args.no_audit:

@@ -1,0 +1,292 @@
+"""Static per-program cost accounting + roofline attribution.
+
+The bench trajectory regressed from 2.1M scores/s at 14% MFU (BENCH_r03) to
+431k at 2.9% (BENCH_r04) and nothing in either artifact could say WHY: we
+measured seconds per phase but never compared them to what the program
+*should* cost. This module closes that gap with two halves:
+
+- **Static cost**: XLA's own cost model, pulled from a compiled executable
+  (``compiled.cost_analysis()`` — flops and bytes accessed). Any jitted
+  program lowers and compiles from the same abstract inputs the PR-6 program
+  registry (analysis/programs.py) already builds, so :func:`cost_table` can
+  price the whole registered-program matrix without running anything.
+
+- **Attribution**: :func:`attribute` joins a program's static cost with its
+  MEASURED device seconds and the chip's peak FLOP/s + HBM bandwidth tables
+  to report achieved FLOP/s, achieved bytes/s, MFU, bandwidth utilization,
+  and a compute-vs-bandwidth-bound roofline verdict — so a bench artifact
+  names the bottleneck instead of just the number.
+
+Consumers: ``bench.py --mode round`` emits a per-phase ``roofline`` section
+(fit / score / round / chunk programs), ``run.py --roofline`` folds the same
+attribution into the JSONL metrics stream as ``roofline`` events, and
+``python -m distributed_active_learning_tpu.analysis --costs`` prints the
+static table for the registry.
+
+Caveat worth keeping in mind: ``cost_analysis`` is the compiler's ESTIMATE
+(post-fusion flops and a bytes-touched model, not an HBM traffic trace), and
+the AOT ``lower().compile()`` path does not share the jit cache — pricing a
+program pays one extra compile. Both halves therefore run strictly OUTSIDE
+timed regions.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+#: Per-chip bf16 peak FLOP/s by jax device_kind prefix (public spec sheets).
+#: bench.py's scoring MFU divides by these; matching prefixes, not equality,
+#: because device_kind strings carry revision suffixes on some runtimes.
+PEAK_BF16_FLOPS = {
+    "TPU v2": 45e12,
+    "TPU v3": 123e12,
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
+
+#: Per-chip HBM bandwidth in bytes/s (public spec sheets). The roofline's
+#: other axis: a program whose arithmetic intensity sits below the chip's
+#: machine balance (peak flops / peak bandwidth) cannot reach peak MFU no
+#: matter how good the kernel is — the verdict names that case
+#: ``bandwidth-bound`` so an MFU drop is read against the right ceiling.
+PEAK_HBM_BYTES_PER_SEC = {
+    "TPU v2": 700e9,
+    "TPU v3": 900e9,
+    "TPU v4": 1200e9,
+    "TPU v5 lite": 819e9,
+    "TPU v5e": 819e9,
+    "TPU v5": 2765e9,
+    "TPU v5p": 2765e9,
+    "TPU v6 lite": 1640e9,
+    "TPU v6e": 1640e9,
+}
+
+
+def _lookup(table: Dict[str, float], kind: str) -> Optional[float]:
+    for name, peak in table.items():
+        if kind.startswith(name):
+            return peak
+    return None
+
+
+def device_kind() -> str:
+    import jax
+
+    return jax.devices()[0].device_kind
+
+
+def peak_flops(kind: Optional[str] = None) -> Tuple[Optional[float], str]:
+    """(bf16 peak FLOP/s, device_kind) for this chip; (None, kind) when the
+    chip has no table entry (CPU, unknown accelerators)."""
+    kind = device_kind() if kind is None else kind
+    return _lookup(PEAK_BF16_FLOPS, kind), kind
+
+
+def peak_bandwidth(kind: Optional[str] = None) -> Tuple[Optional[float], str]:
+    """(HBM peak bytes/s, device_kind), None off the table like peak_flops."""
+    kind = device_kind() if kind is None else kind
+    return _lookup(PEAK_HBM_BYTES_PER_SEC, kind), kind
+
+
+# ---------------------------------------------------------------------------
+# static cost extraction
+# ---------------------------------------------------------------------------
+
+
+def compiled_cost(compiled) -> Dict[str, Optional[float]]:
+    """Normalize ``compiled.cost_analysis()`` into ``{flops, bytes_accessed}``.
+
+    jax has returned both shapes over time: a list with one properties dict
+    per partition (0.4.x) and a bare dict (newer). Multi-partition programs
+    sum. Keys the backend doesn't report come back None, never 0 — a zero
+    would read as "free program" in downstream ratios.
+    """
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return {"flops": None, "bytes_accessed": None}
+    parts = ca if isinstance(ca, (list, tuple)) else [ca]
+    out: Dict[str, Optional[float]] = {"flops": None, "bytes_accessed": None}
+    for key, name in (("flops", "flops"), ("bytes accessed", "bytes_accessed")):
+        vals = [
+            float(p[key])
+            for p in parts
+            if isinstance(p, dict) and isinstance(p.get(key), (int, float))
+        ]
+        if vals:
+            out[name] = sum(vals)
+    return out
+
+
+def program_cost(fn, *args) -> Dict[str, Optional[float]]:
+    """Static cost of one jitted program at these (abstract or concrete)
+    argument shapes: ``{flops, bytes_accessed, flops_per_byte}``.
+
+    Pays one AOT compile (``fn.lower(*args).compile()`` does not share the
+    jit dispatch cache) — call it outside timed regions. Raises on programs
+    that fail to lower/compile; :func:`cost_table` converts that into a
+    per-program error entry instead.
+    """
+    cost = compiled_cost(fn.lower(*args).compile())
+    flops, nbytes = cost["flops"], cost["bytes_accessed"]
+    cost["flops_per_byte"] = (
+        round(flops / nbytes, 4) if flops and nbytes else None
+    )
+    return cost
+
+
+def cost_table(specs) -> Dict[str, Dict[str, Any]]:
+    """Price every registry program (analysis/programs.py ProgramSpecs).
+
+    Returns ``{program name: {flops, bytes_accessed, flops_per_byte}}``;
+    builders that decline (SkipProgram: mesh variants without devices) get
+    ``{"skipped": reason}`` and build/compile failures ``{"error": ...}`` —
+    the table never silently drops a registered program.
+    """
+    from distributed_active_learning_tpu.analysis.programs import SkipProgram
+
+    table: Dict[str, Dict[str, Any]] = {}
+    for spec in specs:
+        try:
+            unit = spec.build()
+            table[spec.name] = program_cost(unit.fn, *unit.args)
+        except SkipProgram as skip:
+            table[spec.name] = {"skipped": str(skip)}
+        except Exception as e:  # noqa: BLE001 — per-program, keep pricing
+            table[spec.name] = {"error": f"{type(e).__name__}: {e}"}
+    return table
+
+
+# ---------------------------------------------------------------------------
+# attribution: join static cost with measured seconds
+# ---------------------------------------------------------------------------
+
+
+def roofline_verdict(
+    mfu: Optional[float],
+    bw_util: Optional[float],
+    flops_per_byte: Optional[float],
+    machine_balance: Optional[float],
+) -> str:
+    """Name the binding resource.
+
+    Preferred evidence is MEASURED: whichever utilization (MFU vs bandwidth)
+    is higher is the wall the program is closer to. Without peaks (CPU, an
+    untabled chip) fall back to the STATIC comparison — arithmetic intensity
+    vs machine balance — and say so in the verdict, since a static verdict
+    cannot see a badly-scheduled kernel. ``indeterminate`` only when neither
+    side has data.
+    """
+    if mfu is not None and bw_util is not None:
+        return "compute-bound" if mfu >= bw_util else "bandwidth-bound"
+    if flops_per_byte is not None and machine_balance is not None:
+        side = "compute" if flops_per_byte >= machine_balance else "bandwidth"
+        return f"{side}-bound(static)"
+    if flops_per_byte is not None:
+        # Cost known but the chip has no peak table (CPU smoke runs): the
+        # verdict is honest about WHY it cannot rule, not just absent.
+        return "indeterminate:no-peak-table"
+    return "indeterminate"
+
+
+def attribute(
+    cost: Dict[str, Optional[float]],
+    seconds: Optional[float],
+    *,
+    peak_flops_per_sec: Optional[float] = None,
+    peak_bytes_per_sec: Optional[float] = None,
+    n_devices: int = 1,
+) -> Dict[str, Any]:
+    """Join one program's static cost with its measured device seconds.
+
+    ``peak_*`` default to this chip's tables (times ``n_devices`` for mesh
+    programs, matching bench.py's aggregate-MFU convention). Returns a flat
+    JSON-ready dict: the static keys pass through, plus ``seconds``,
+    ``achieved_gflops_per_sec``, ``achieved_gbytes_per_sec``, ``mfu``,
+    ``bandwidth_util``, and ``bound``.
+    """
+    if peak_flops_per_sec is None:
+        peak_flops_per_sec, _ = peak_flops()
+    if peak_bytes_per_sec is None:
+        peak_bytes_per_sec, _ = peak_bandwidth()
+    if peak_flops_per_sec is not None:
+        peak_flops_per_sec *= max(n_devices, 1)
+    if peak_bytes_per_sec is not None:
+        peak_bytes_per_sec *= max(n_devices, 1)
+    flops, nbytes = cost.get("flops"), cost.get("bytes_accessed")
+    achieved_f = flops / seconds if flops and seconds else None
+    achieved_b = nbytes / seconds if nbytes and seconds else None
+    mfu = (
+        achieved_f / peak_flops_per_sec
+        if achieved_f is not None and peak_flops_per_sec
+        else None
+    )
+    bw_util = (
+        achieved_b / peak_bytes_per_sec
+        if achieved_b is not None and peak_bytes_per_sec
+        else None
+    )
+    balance = (
+        peak_flops_per_sec / peak_bytes_per_sec
+        if peak_flops_per_sec and peak_bytes_per_sec
+        else None
+    )
+    return {
+        "flops": flops,
+        "bytes_accessed": nbytes,
+        "flops_per_byte": cost.get("flops_per_byte"),
+        "seconds": round(seconds, 6) if seconds is not None else None,
+        "achieved_gflops_per_sec": (
+            round(achieved_f / 1e9, 3) if achieved_f is not None else None
+        ),
+        "achieved_gbytes_per_sec": (
+            round(achieved_b / 1e9, 3) if achieved_b is not None else None
+        ),
+        "mfu": round(mfu, 5) if mfu is not None else None,
+        "bandwidth_util": round(bw_util, 5) if bw_util is not None else None,
+        "bound": roofline_verdict(
+            mfu, bw_util, cost.get("flops_per_byte"), balance
+        ),
+    }
+
+
+def render_cost_table(table: Dict[str, Dict[str, Any]]) -> str:
+    """Human table for ``--costs``: one row per program, sorted by name."""
+    header = ("program", "flops", "bytes", "flops/byte")
+    rows = []
+    for name in sorted(table):
+        entry = table[name]
+        if "skipped" in entry:
+            rows.append((name, "(skipped)", entry["skipped"][:40], ""))
+            continue
+        if "error" in entry:
+            rows.append((name, "(error)", entry["error"][:40], ""))
+            continue
+
+        def _fmt(v):
+            return f"{v:,.0f}" if isinstance(v, (int, float)) else "?"
+
+        rows.append(
+            (
+                name,
+                _fmt(entry.get("flops")),
+                _fmt(entry.get("bytes_accessed")),
+                str(entry.get("flops_per_byte") or "?"),
+            )
+        )
+    widths = [
+        max(len(header[i]), *(len(r[i]) for r in rows)) if rows else len(header[i])
+        for i in range(len(header))
+    ]
+
+    def _row(cols):
+        return "  ".join(str(c).ljust(w) for c, w in zip(cols, widths))
+
+    return "\n".join(
+        [_row(header), _row(["-" * w for w in widths])] + [_row(r) for r in rows]
+    )

@@ -206,6 +206,14 @@ class ExperimentConfig:
     # no extra host syncs; a MetricsWriter passed to run_experiment enables
     # this implicitly.
     collect_metrics: bool = False
+    # Emit per-program roofline attribution (analysis/roofline.py) into the
+    # metrics stream at run end: the launched chunk program's static
+    # cost_analysis (flops, bytes accessed) joined with its measured launch
+    # seconds into achieved FLOP/s, bandwidth, MFU, and a compute-vs-
+    # bandwidth bound verdict (`roofline` JSONL events). Costs one extra AOT
+    # compile of the chunk program AFTER the run finishes; no effect without
+    # a MetricsWriter or on the per-round fallback path.
+    roofline: bool = False
     log_every: int = 1
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 0  # 0 = disabled

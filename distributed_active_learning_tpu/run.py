@@ -115,6 +115,24 @@ def build_parser() -> argparse.ArgumentParser:
         "are name-scoped, so device time is attributable per AL phase",
     )
     ap.add_argument(
+        "--roofline", action="store_true",
+        help="with --metrics-out and a fused forest run (--fit device, "
+        "--rounds-per-launch > 1): price the launched chunk program with "
+        "XLA's cost model (analysis/roofline.py) after the run and emit a "
+        "'roofline' JSONL event — static flops/bytes joined with measured "
+        "launch seconds into achieved FLOP/s, bandwidth, MFU, and a "
+        "compute-vs-bandwidth bound verdict. Pays one extra (AOT) compile "
+        "after the run finishes",
+    )
+    ap.add_argument(
+        "--flight-recorder", default=None, metavar="PATH",
+        help="record launch/touchdown/veto/recompile events into a bounded "
+        "in-process ring buffer and dump the last N as one JSON artifact at "
+        "PATH on SIGUSR1 (probe a live run), SIGTERM, unhandled crash, and "
+        "normal exit — a dead run leaves a trace of what it was doing "
+        "(runtime/telemetry.py FlightRecorder)",
+    )
+    ap.add_argument(
         "--phase-detail", action="store_true",
         help="force per-phase (train/round/eval) host wall splits; with "
         "--rounds-per-launch > 1 this disables scan fusion (phases cannot "
@@ -225,6 +243,11 @@ def main(argv=None) -> int:
     from distributed_active_learning_tpu.runtime.debugger import Debugger
     from distributed_active_learning_tpu.runtime.loop import run_experiment
 
+    if args.flight_recorder:
+        from distributed_active_learning_tpu.runtime import telemetry
+
+        telemetry.install_flight_recorder(args.flight_recorder)
+
     # phase_detail defaults False since the telemetry PR: an enabled Debugger
     # no longer costs a fused run its scan fusion (per-round visibility comes
     # from the in-scan RoundMetrics instead); --phase-detail opts back into
@@ -296,6 +319,7 @@ def main(argv=None) -> int:
             if writer is not None:
                 writer.close()
         _emit(args, result, dbg)
+        _flight_exit_dump(args)
         return 0
 
     from distributed_active_learning_tpu.runtime.neural_loop import is_deep_strategy
@@ -334,6 +358,7 @@ def main(argv=None) -> int:
         pipeline_depth=args.pipeline_depth,
         sweep_seeds=args.sweep_seeds,
         stream_round_events=args.stream_rounds,
+        roofline=args.roofline,
         seed=args.seed,
         results_path=None,  # _emit handles --out for both loop kinds
         checkpoint_dir=args.checkpoint_dir,
@@ -358,7 +383,18 @@ def main(argv=None) -> int:
         _emit_sweep(args, results, seeds, dbg)
     else:
         _emit(args, result, dbg)
+    _flight_exit_dump(args)
     return 0
+
+
+def _flight_exit_dump(args) -> None:
+    """--flight-recorder: a normal exit also leaves the artifact (the crash
+    and signal triggers are armed by install_flight_recorder; this covers
+    the run that simply finished)."""
+    if getattr(args, "flight_recorder", None):
+        from distributed_active_learning_tpu.runtime import telemetry
+
+        telemetry.flight_dump("exit")
 
 
 def _audit_or_die(args, cfg=None, neural_strategy=None):

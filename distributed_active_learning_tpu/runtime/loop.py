@@ -759,6 +759,20 @@ def run_experiment(
                 on_veto=lambda idx: launches.veto(idx, ctl.veto_reason(idx)),
             )
 
+        if metrics is not None and getattr(cfg, "roofline", False):
+            # Roofline attribution of the launched chunk program (run.py
+            # --roofline): price it with XLA's cost model and join the
+            # tracker's steady-state launch seconds. After the drive, not
+            # during — the AOT lower().compile() pays one extra compile.
+            # The post-run carry has the exact avals the launches used (the
+            # carry-aval audit rule guarantees it), so it serves as the
+            # pricing input without keeping the initial state alive.
+            telemetry.emit_roofline(
+                metrics, launches, chunk_fn,
+                (codes, state, aux, fit_key, test_x, test_y, end_round),
+                n_devices=mesh.devices.size if mesh is not None else 1,
+            )
+
         if cfg.results_path:
             result.save(cfg.results_path, fmt="reference")
         return result

@@ -58,6 +58,8 @@ from typing import Any, Callable, NamedTuple, Optional
 
 import jax
 
+from distributed_active_learning_tpu.runtime import telemetry
+
 
 class ChunkExtras(NamedTuple):
     """The two scalar chunk outputs the host stop decision blocks on.
@@ -309,6 +311,9 @@ def run_pipelined(
         # Kick off the async D2H copy of everything the touchdown will read.
         start_host_copy((extras, ys))
         inflight.append(_InFlight(next_index, extras, ys, state, t0))
+        telemetry.flight_record(
+            "dispatch", index=next_index, inflight=len(inflight), depth=depth,
+        )
         next_index += 1
 
     while True:
@@ -351,6 +356,10 @@ def run_pipelined(
         while depth > 1 and len(inflight) < depth and _can_dispatch():
             _dispatch_one()
         t_td = time.perf_counter()
+        telemetry.flight_record(
+            "touchdown", index=head.index, n_active=n_active,
+            n_labeled_after=n_labeled_after, inflight=len(inflight),
+        )
         touchdown(
             head.index, n_labeled_after, n_active, head.ys, head.out_state,
             launch_wall,

@@ -43,9 +43,11 @@ table the reference printed.
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import json
 import os
+import threading
 import time
 from typing import Any, Dict, List, Optional
 
@@ -402,8 +404,6 @@ class MetricsWriter:
     def __init__(
         self, path: str, rank: Optional[int] = None, flush_every: int = 1
     ):
-        import threading
-
         self.path = path
         self.rank = jax.process_index() if rank is None else rank
         self.flush_every = max(int(flush_every), 1)
@@ -526,6 +526,14 @@ class MetricsWriter:
             },
         )
 
+    def roofline(self, program: str, **fields) -> None:
+        """Per-program roofline attribution (analysis/roofline.py): static
+        flops/bytes joined with measured seconds into achieved FLOP/s,
+        bandwidth, MFU, and the compute-vs-bandwidth bound verdict — emitted
+        once per program at run end (the cost extraction pays an AOT
+        compile, so it never rides the hot path)."""
+        self.event("roofline", program=program, **fields)
+
     # -- lifecycle -----------------------------------------------------------
 
     def flush(self) -> None:
@@ -602,6 +610,190 @@ def install_exit_flush(writer: MetricsWriter) -> None:
         pass  # non-main thread: atexit still covers clean exits
 
 
+# ---------------------------------------------------------------------------
+# Layer 4: the launch flight recorder
+# ---------------------------------------------------------------------------
+
+
+class FlightRecorder:
+    """Bounded in-process ring buffer of runtime events — the post-mortem.
+
+    BENCH_r05 died at rc 124 with ``parsed: null`` and left NOTHING saying
+    what it was doing; the JSONL metrics stream only exists when a writer was
+    configured, and the bench never configures one. The flight recorder is
+    the always-cheap middle ground: every launch / touchdown / veto / refit /
+    growth / recompile event (and the bench's mode transitions) appends a
+    small dict to a fixed-capacity deque — no I/O, no device reads — and
+    :meth:`dump` writes the last N events as one JSON artifact when something
+    goes wrong: SIGUSR1 (operator probe of a live run), SIGTERM (an outer
+    ``timeout`` unwinding), or an unhandled crash (sys.excepthook).
+
+    Library code records through the module-level :func:`flight_record`
+    hook, which is a no-op until :func:`install_flight_recorder` runs — the
+    fast paths never pay for a recorder nobody installed.
+    """
+
+    def __init__(self, path: Optional[str] = None, capacity: int = 256):
+        self.path = path
+        self.capacity = int(capacity)
+        self._events: collections.deque = collections.deque(maxlen=self.capacity)
+        # REENTRANT: dump() runs from signal handlers, which interrupt the
+        # main thread between bytecodes — possibly inside record()'s locked
+        # block. A plain Lock would deadlock there (the holder is the very
+        # frame the handler interrupted); with an RLock the handler's dump
+        # proceeds, at worst seeing a half-recorded last event.
+        self._lock = threading.RLock()
+        self._seq = 0
+        self._dumped_reasons: List[str] = []
+
+    def record(self, kind: str, **fields) -> None:
+        ev = {"seq": 0, "ts": round(time.time(), 3), "kind": kind}
+        ev.update(fields)
+        with self._lock:
+            self._seq += 1
+            ev["seq"] = self._seq
+            self._events.append(ev)
+
+    def snapshot(self) -> List[dict]:
+        with self._lock:
+            return list(self._events)
+
+    @property
+    def dropped(self) -> int:
+        """Events that aged out of the ring (total recorded - retained)."""
+        with self._lock:
+            return self._seq - len(self._events)
+
+    def dump(self, reason: str) -> Optional[str]:
+        """Write the ring to ``self.path`` as one JSON artifact; returns the
+        path (None when the recorder has no path). Safe to call repeatedly —
+        each dump rewrites the artifact with the reasons seen so far, so a
+        SIGTERM dump followed by the unwind's crash dump keeps both labels.
+        Atomic rename so a kill mid-dump never leaves a torn artifact."""
+        if not self.path:
+            return None
+        with self._lock:
+            payload = {
+                "schema": 1,
+                "reason": reason,
+                "reasons": self._dumped_reasons + [reason],
+                "pid": os.getpid(),
+                "dumped_ts": round(time.time(), 3),
+                "capacity": self.capacity,
+                "recorded_total": self._seq,
+                "dropped": self._seq - len(self._events),
+                "events": [MetricsWriter._json_safe(e) for e in self._events],
+            }
+            self._dumped_reasons.append(reason)
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        parent = os.path.dirname(os.path.abspath(self.path))
+        os.makedirs(parent, exist_ok=True)
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, self.path)
+        return self.path
+
+
+_FLIGHT_RECORDER: Optional[FlightRecorder] = None
+
+
+def flight_recorder() -> Optional[FlightRecorder]:
+    return _FLIGHT_RECORDER
+
+
+def flight_record(kind: str, **fields) -> None:
+    """Record into the installed flight recorder; no-op without one. The
+    library-side hook: LaunchTracker / the pipelined driver / the streaming
+    service call this unconditionally."""
+    rec = _FLIGHT_RECORDER
+    if rec is not None:
+        rec.record(kind, **fields)
+
+
+def flight_dump(reason: str) -> Optional[str]:
+    """Dump the installed recorder (no-op None without one)."""
+    rec = _FLIGHT_RECORDER
+    return rec.dump(reason) if rec is not None else None
+
+
+def install_flight_recorder(
+    path: Optional[str],
+    capacity: int = 256,
+    signals: bool = True,
+) -> FlightRecorder:
+    """Install the process-wide flight recorder (replacing any previous one).
+
+    With ``signals=True`` (drivers; tests pass False to keep the pytest
+    process unhooked) also arms the dump triggers:
+
+    - **SIGUSR1** dumps and keeps running — probe a live run from outside
+      (``kill -USR1 <pid>``) without disturbing it;
+    - **SIGTERM** dumps, then CHAINS to the previously-installed handler
+      (bench.py's JSON-printing unwinder, the default terminator, ...) —
+      same discipline as :func:`install_exit_flush`;
+    - **sys.excepthook** dumps on an unhandled crash, then chains.
+    """
+    import signal
+    import sys
+
+    global _FLIGHT_RECORDER
+    rec = FlightRecorder(path, capacity)
+    _FLIGHT_RECORDER = rec
+    if not signals:
+        return rec
+
+    def _usr1(_signum, _frame):
+        try:
+            rec.dump("sigusr1")
+        except OSError:
+            pass  # a probe of a live run must never kill it
+
+    try:
+        signal.signal(signal.SIGUSR1, _usr1)
+    except (ValueError, AttributeError):
+        pass  # non-main thread / platform without SIGUSR1
+
+    prev_term = signal.getsignal(signal.SIGTERM)
+
+    def _term(signum, frame):
+        try:
+            rec.dump("sigterm")
+        except OSError:
+            pass  # an unwritable path must not eat the shutdown
+        if callable(prev_term):
+            prev_term(signum, frame)
+        elif prev_term == signal.SIG_DFL:
+            signal.signal(signal.SIGTERM, signal.SIG_DFL)
+            signal.raise_signal(signal.SIGTERM)
+
+    if prev_term is not None:  # None = C-installed, unchainable; leave it
+        try:
+            signal.signal(signal.SIGTERM, _term)
+        except ValueError:
+            pass
+
+    prev_hook = sys.excepthook
+
+    def _crash_hook(exc_type, exc, tb):
+        try:
+            rec.dump(f"crash:{exc_type.__name__}")
+        except OSError:
+            pass
+        prev_hook(exc_type, exc, tb)
+
+    sys.excepthook = _crash_hook
+    return rec
+
+
+def uninstall_flight_recorder() -> None:
+    """Detach the recorder from :func:`flight_record` (tests). Signal
+    handlers armed by a ``signals=True`` install keep a reference to their
+    own recorder and would still dump its now-frozen ring — tests wanting
+    full isolation install with ``signals=False``."""
+    global _FLIGHT_RECORDER
+    _FLIGHT_RECORDER = None
+
+
 class LaunchTracker:
     """Per-program compile-vs-execute split + recompile detection.
 
@@ -616,6 +808,8 @@ class LaunchTracker:
         self.fn = fn
         self.calls = 0
         self.vetoes = 0
+        self.seconds_total = 0.0
+        self.first_seconds: Optional[float] = None  # the compile call's wall
         self._last_cache = None
 
     def veto(self, index: int, reason: Optional[str]) -> None:
@@ -625,6 +819,10 @@ class LaunchTracker:
         counts are assertable from the JSONL stream — previously a vetoed
         launch was just silence."""
         self.vetoes += 1
+        flight_record(
+            "launch_veto", program=self.program, index=index,
+            reason=reason or "unknown",
+        )
         if self.writer is not None:
             self.writer.event(
                 "launch_veto",
@@ -636,10 +834,13 @@ class LaunchTracker:
     def record(self, seconds: float, **extra) -> None:
         """One launch observation; ``extra`` (e.g. the pipelined driver's
         ``touchdown_seconds``/``overlap_seconds``/``touchdown_hidden_fraction``)
-        rides the JSONL event verbatim."""
+        rides the JSONL event verbatim. Mirrored into the flight recorder
+        (when installed) even without a writer — the post-mortem must not
+        depend on --metrics-out having been passed."""
         self.calls += 1
-        if self.writer is None:
-            return
+        self.seconds_total += seconds
+        if self.calls == 1:
+            self.first_seconds = seconds
         cache = jit_cache_size(self.fn) if self.fn is not None else None
         recompiled = (
             self.calls > 1
@@ -648,6 +849,18 @@ class LaunchTracker:
             and cache > self._last_cache
         )
         self._last_cache = cache
+        flight_record(
+            "launch", program=self.program, call=self.calls,
+            seconds=round(seconds, 6), first_call=self.calls == 1,
+            recompiled=recompiled,
+        )
+        if recompiled:
+            flight_record(
+                "recompile", program=self.program, call=self.calls,
+                cache_size=cache,
+            )
+        if self.writer is None:
+            return
         self.writer.launch(
             self.program,
             seconds,
@@ -656,3 +869,49 @@ class LaunchTracker:
             recompiled=recompiled,
             **extra,
         )
+
+    def steady_seconds_mean(self) -> Optional[float]:
+        """Mean wall per launch EXCLUDING the first call (trace + XLA
+        compile); the first call itself when it is all we have. None before
+        any launch — roofline attribution must not divide by a guess."""
+        if self.calls == 0:
+            return None
+        if self.calls == 1 or self.first_seconds is None:
+            return self.seconds_total / self.calls
+        return (self.seconds_total - self.first_seconds) / (self.calls - 1)
+
+
+def emit_roofline(
+    writer, tracker: LaunchTracker, fn, args, n_devices: int = 1
+) -> Optional[dict]:
+    """Join ``fn``'s static cost with ``tracker``'s measured launch seconds
+    and emit one ``roofline`` JSONL event (plus a flight-recorder echo).
+
+    Called AFTER a run completes (run.py ``--roofline`` via the chunked
+    driver): the cost extraction compiles the program again through the AOT
+    path, so it must never sit inside a timed region. ``n_devices`` must be
+    the mesh size for sharded programs — MFU divides by the AGGREGATE peak,
+    and defaulting a mesh run to one chip would overstate it mesh-fold.
+    Failures degrade to an event carrying ``error`` — attribution is
+    diagnostics, it must not kill a finished run. Returns the attribution
+    dict (or None on failure).
+    """
+    from distributed_active_learning_tpu.analysis import roofline as roofline_lib
+
+    seconds = tracker.steady_seconds_mean()
+    try:
+        cost = roofline_lib.program_cost(fn, *args)
+    except Exception as e:  # noqa: BLE001 — diagnostics must not raise
+        if writer is not None:
+            writer.roofline(
+                tracker.program, error=f"{type(e).__name__}: {e}"
+            )
+        return None
+    attr = roofline_lib.attribute(cost, seconds, n_devices=n_devices)
+    if writer is not None:
+        writer.roofline(tracker.program, calls=tracker.calls, **attr)
+    flight_record(
+        "roofline", program=tracker.program, bound=attr["bound"],
+        mfu=attr["mfu"],
+    )
+    return attr

@@ -81,6 +81,13 @@ class _ProgramTracker:
         )
         if recompiled:
             self.recompiles += 1
+            # A silent recompile is exactly the event a dead run's post-
+            # mortem needs; the score path's per-query launches stay out of
+            # the ring (they'd flush everything else) — recompiles don't.
+            telemetry.flight_record(
+                "recompile", program=self.program, call=self.calls,
+                cache_size=cache,
+            )
         self._last_cache = cache
         if self.writer is not None:
             self.writer.launch(
@@ -213,6 +220,12 @@ class ALService:
         self._ingest_buf_y: list = []
         self._inflight = None
         self._inflight_polls = 0
+        # Concurrent-cause tags for the NEXT serve_latency event: slab
+        # growths and refit dispatches queue device work (and one-off
+        # compiles) that the following score query pays for as a latency
+        # spike — tagging the query with what ran beside it makes the serve
+        # bench's p99 attributable (summarize_metrics groups by cause).
+        self._latency_causes: set = set()
 
         if metrics is not None:
             from distributed_active_learning_tpu.config import asdict as cfg_asdict
@@ -304,10 +317,21 @@ class ALService:
         self.drift.observe_serve(float(np.mean(np.asarray(ent)[:n])))
         self.stats.queries += 1
         self.stats.scored_points += n
+        # The concurrent cause this query's latency is attributable to:
+        # a slab growth's one-per-new-capacity compile outranks an ordinary
+        # refit dispatch (both can be pending; the compile is the spike).
+        if "slab_growth_compile" in self._latency_causes:
+            cause = "slab_growth_compile"
+        elif "refit_dispatch" in self._latency_causes or self._inflight is not None:
+            cause = "refit_dispatch"
+        else:
+            cause = "none"
+        self._latency_causes.clear()
         if self.metrics is not None:
             self.metrics.event(
                 "serve_latency", seconds=round(dt, 6), batch=n,
                 inflight_refit=self._inflight is not None,
+                cause=cause,
             )
         self._maybe_refit()
         return scores_np
@@ -390,6 +414,11 @@ class ALService:
                 seed_mask=self._pad_seed_mask(self._aux.seed_mask)
             )
         self.stats.slab_growths += 1
+        self._latency_causes.add("slab_growth_compile")
+        telemetry.flight_record(
+            "slab_grow", capacity=self._slab.capacity, fill=self._fill,
+            buffered=sum(len(b) for b in self._ingest_buf_x),
+        )
         if self.metrics is not None:
             self.metrics.event(
                 "slab_grow", capacity=self._slab.capacity, fill=self._fill
@@ -460,6 +489,13 @@ class ALService:
         self._inflight_polls = 0
         self.stats.refits += 1
         self.refit_reasons[reason] = self.refit_reasons.get(reason, 0) + 1
+        self._latency_causes.add("refit_dispatch")
+        telemetry.flight_record(
+            "refit", reason=reason, rounds=self.serve.refit_rounds,
+            labeled=self._labeled, fill=self._fill,
+            capacity=self._slab.capacity,
+            buffered=sum(len(b) for b in self._ingest_buf_x),
+        )
         if self.metrics is not None:
             self.metrics.event(
                 "refit", reason=reason, rounds=self.serve.refit_rounds,
@@ -485,6 +521,11 @@ class ALService:
         n_labeled_after = int(extras.n_labeled_after)  # blocks if still running
         n_active = int(extras.n_active)
         dt = time.perf_counter() - t0
+        telemetry.flight_record(
+            "touchdown", program=progs.chunk_tracker.program, reason=reason,
+            n_active=n_active, n_labeled_after=n_labeled_after,
+            seconds=round(dt, 6), polls=self._inflight_polls,
+        )
         progs.chunk_tracker.record(dt, reason=reason)
         self._labeled = n_labeled_after
         self._round_host += n_active

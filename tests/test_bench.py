@@ -74,6 +74,53 @@ def test_bench_round_contract(bench):
     assert r["round_seconds"] > 0 and r["round_seconds_host_fit"] > 0
     assert r["vs_baseline"] > 0
     assert r["round_device_seconds"] > 0 and r["vs_baseline_device"] > 0
+    # Per-phase roofline section (the observability tentpole): static cost
+    # joined with measured seconds for the fit, the fused round, and the
+    # fused CHUNK program, each carrying a bound verdict. On CPU there is no
+    # peak table, so mfu is None and the verdict says why it cannot rule.
+    roof = r["roofline"]
+    assert "error" not in roof, roof
+    for phase in ("fit", "round", "chunk"):
+        entry = roof[phase]
+        assert entry["flops"] > 0 and entry["bytes_accessed"] > 0, (phase, entry)
+        assert entry["seconds"] is None or entry["seconds"] >= 0
+        assert "mfu" in entry and "bound" in entry
+        assert entry["bound"] == "indeterminate:no-peak-table"  # CPU: no peaks
+    assert roof["chunk"]["rounds_per_launch"] >= 1
+    # fused-round flops can't be less than its fit half's
+    assert roof["round"]["flops"] >= roof["fit"]["flops"]
+
+
+def test_mode_all_deadline_skips_are_structured(bench):
+    """modes_skipped carries one dict per skipped mode — the reason, the
+    elapsed budget when the decision fell, and (for pre-estimates) the mode
+    cost that would not have fit — instead of the old bare name list."""
+    import time as time_mod
+
+    args = _args(mode="all")
+    # clock long past the deadline: every mode skips as deadline_exceeded
+    args._start_time = time_mod.perf_counter() - 1000.0
+    args.deadline = 1.0
+    out = bench._run_mode(args)
+    assert out["metric"] == "none_completed_before_deadline"
+    skips = out["modes_skipped"]
+    assert [s["mode"] for s in skips] == [
+        "score", "density", "round", "sweep", "serve", "lal", "neural",
+    ]
+    for s in skips:
+        assert s["reason"] == "deadline_exceeded"
+        assert s["elapsed_at_skip_seconds"] > 0
+        assert s["deadline_seconds"] == 1.0
+
+    # fresh clock but a deadline below every CPU cost estimate: the skip is
+    # a prediction and says what it predicted
+    args2 = _args(mode="all")
+    args2._start_time = time_mod.perf_counter()
+    args2.deadline = 5.0
+    out2 = bench._run_mode(args2)
+    s0 = out2["modes_skipped"][0]
+    assert s0["reason"] == "predicted_overrun"
+    assert s0["estimated_mode_seconds"] > 0
 
 
 def test_bench_score_pallas_kernel(bench):

@@ -174,6 +174,7 @@ def test_cli_neural_mesh_model_rejected():
         ])
 
 
+@pytest.mark.slow  # ~28s: full LAL CLI e2e; LAL stays covered by test_strategies + bench lal
 def test_cli_lal_on_reference_fixture(capsys, tmp_path):
     """--strategy lal from the CLI on the reference's own checkerboard files,
     with the regressor persisted via lal_model_path (the try-load-else-train
@@ -296,6 +297,7 @@ def test_cli_profile_dir_unwritable_errors_before_run(tmp_path):
         ])
 
 
+@pytest.mark.slow  # ~12s trace capture; profiler plumbing stays covered by test_telemetry's profile_session test
 def test_cli_profile_dir_writes_trace(tmp_path):
     """--profile-dir reaches profiler_trace (dead code from the seed until
     this PR) on the forest path and leaves trace artifacts."""

@@ -106,6 +106,7 @@ def test_neural_loop_end_to_end_tabular(strategy):
     assert 0.0 <= res.final_accuracy <= 1.0
 
 
+@pytest.mark.slow  # ~15s conv compile; CNN path stays covered by the CLI image-dataset e2e tests
 def test_neural_loop_cnn_image_shape():
     k = jax.random.key(4)
     n = 96

@@ -1,0 +1,262 @@
+"""The launch flight recorder (runtime/telemetry.py FlightRecorder): ring
+semantics, dump triggers (SIGUSR1 / SIGTERM chain / crash excepthook), the
+library-side flight_record mirror in LaunchTracker, its interplay with a
+buffered MetricsWriter, and the bench.py SIGTERM acceptance: a killed bench
+leaves an artifact whose last events identify the in-flight mode and launch."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distributed_active_learning_tpu.runtime import telemetry
+
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def recorder(tmp_path):
+    """An installed recorder with NO signal hooks (the pytest process must
+    stay unhooked); always uninstalled afterwards."""
+    rec = telemetry.install_flight_recorder(
+        str(tmp_path / "flight.json"), capacity=64, signals=False
+    )
+    try:
+        yield rec
+    finally:
+        telemetry.uninstall_flight_recorder()
+
+
+def test_ring_is_bounded_and_counts_drops(tmp_path):
+    rec = telemetry.FlightRecorder(str(tmp_path / "f.json"), capacity=4)
+    for i in range(10):
+        rec.record("e", i=i)
+    snap = rec.snapshot()
+    assert [e["i"] for e in snap] == [6, 7, 8, 9]
+    assert [e["seq"] for e in snap] == [7, 8, 9, 10]
+    assert rec.dropped == 6
+    path = rec.dump("test")
+    doc = json.load(open(path))
+    assert doc["reason"] == "test" and doc["dropped"] == 6
+    assert doc["recorded_total"] == 10 and len(doc["events"]) == 4
+    # repeated dumps accumulate their reasons (sigterm then crash, say)
+    rec.dump("again")
+    assert json.load(open(path))["reasons"] == ["test", "again"]
+
+
+def test_flight_record_is_noop_without_recorder(tmp_path):
+    telemetry.uninstall_flight_recorder()
+    telemetry.flight_record("e", x=1)  # must not raise
+    assert telemetry.flight_dump("r") is None
+
+
+def test_launch_tracker_mirrors_into_recorder_without_writer(recorder):
+    f = jax.jit(lambda x: x + 1)
+    tracker = telemetry.LaunchTracker(None, "prog", fn=f)
+    f(jnp.ones(4))
+    tracker.record(0.5)
+    f(jnp.ones(8))  # shape change -> jit cache grows -> recompile detected
+    tracker.record(0.1)
+    tracker.veto(7, "max_rounds_bound")
+    kinds = [(e["kind"], e.get("program")) for e in recorder.snapshot()]
+    assert ("launch", "prog") in kinds
+    assert ("recompile", "prog") in kinds
+    assert ("launch_veto", "prog") in kinds
+    launches = [e for e in recorder.snapshot() if e["kind"] == "launch"]
+    assert launches[0]["first_call"] and not launches[1]["first_call"]
+    assert launches[1]["recompiled"]
+
+
+def test_buffered_writer_vs_recorder_visibility(recorder, tmp_path):
+    """flush_every buffering interacts correctly with the new event types:
+    the writer holds roofline/launch events in its buffer while the flight
+    recorder sees them immediately; a flush makes the JSONL catch up."""
+    path = str(tmp_path / "m.jsonl")
+    w = telemetry.MetricsWriter(path, rank=0, flush_every=1000)
+    tracker = telemetry.LaunchTracker(w, "chunk_scan")
+    tracker.record(0.2)
+    w.roofline("chunk_scan", flops=1e9, bound="compute-bound")
+    telemetry.flight_record("roofline", program="chunk_scan", bound="compute-bound")
+    # recorder: already visible; writer: buffered (nothing durable yet)
+    kinds = [e["kind"] for e in recorder.snapshot()]
+    assert "launch" in kinds and "roofline" in kinds
+    assert os.path.getsize(path) == 0 if os.path.exists(path) else True
+    w.flush()
+    events = [json.loads(line) for line in open(path)]
+    assert [e["kind"] for e in events] == ["launch", "roofline"]
+    assert events[1]["bound"] == "compute-bound"
+    w.close()
+
+
+def test_sigterm_flushes_buffered_writer_and_dumps_recorder(tmp_path):
+    """The SIGTERM exit path end-to-end: install_exit_flush keeps a buffered
+    writer's roofline/launch tail AND the recorder's SIGTERM hook dumps the
+    ring, chaining so the exit status still reports the TERM."""
+    jsonl = str(tmp_path / "m.jsonl")
+    flight = str(tmp_path / "flight.json")
+    script = textwrap.dedent(f"""
+        import time
+        from distributed_active_learning_tpu.runtime import telemetry as t
+        w = t.MetricsWriter({jsonl!r}, rank=0, flush_every=100000)
+        t.install_exit_flush(w)
+        t.install_flight_recorder({flight!r}, capacity=32)
+        tracker = t.LaunchTracker(w, "chunk_scan")
+        for i in range(5):
+            tracker.record(0.01 * (i + 1))
+        w.roofline("chunk_scan", flops=2.0e9, mfu=0.125, bound="compute-bound")
+        print("READY", flush=True)
+        time.sleep(60)
+    """)
+    proc = subprocess.Popen(
+        [sys.executable, "-c", script],
+        stdout=subprocess.PIPE,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        text=True,
+    )
+    try:
+        assert proc.stdout.readline().strip() == "READY"
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert rc == -signal.SIGTERM
+    events = [json.loads(line) for line in open(jsonl) if line.strip()]
+    assert sum(e["kind"] == "launch" for e in events) == 5
+    assert any(
+        e["kind"] == "roofline" and e["bound"] == "compute-bound"
+        for e in events
+    )
+    doc = json.load(open(flight))
+    assert doc["reason"] == "sigterm"
+    assert [e["kind"] for e in doc["events"]].count("launch") == 5
+
+
+def test_sigusr1_dumps_without_disturbing_the_process(tmp_path):
+    flight = str(tmp_path / "flight.json")
+    script = textwrap.dedent(f"""
+        import os, signal, time
+        from distributed_active_learning_tpu.runtime import telemetry as t
+        t.install_flight_recorder({flight!r}, capacity=8)
+        t.flight_record("probe", phase="before")
+        os.kill(os.getpid(), signal.SIGUSR1)
+        time.sleep(0.2)
+        t.flight_record("probe", phase="after")
+        print("ALIVE", flush=True)
+    """)
+    out = subprocess.run(
+        [sys.executable, "-c", script],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert out.returncode == 0 and "ALIVE" in out.stdout
+    doc = json.load(open(flight))
+    assert doc["reason"] == "sigusr1"
+    # the dump happened between the two probes: only "before" is in it
+    phases = [e.get("phase") for e in doc["events"] if e["kind"] == "probe"]
+    assert phases == ["before"]
+
+
+def test_unhandled_crash_dumps_via_excepthook(tmp_path):
+    flight = str(tmp_path / "flight.json")
+    script = textwrap.dedent(f"""
+        from distributed_active_learning_tpu.runtime import telemetry as t
+        t.install_flight_recorder({flight!r})
+        t.flight_record("doomed", step=1)
+        raise RuntimeError("boom")
+    """)
+    out = subprocess.run(
+        [sys.executable, "-c", script],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert out.returncode == 1 and "boom" in out.stderr
+    doc = json.load(open(flight))
+    assert doc["reason"] == "crash:RuntimeError"
+    assert any(e["kind"] == "doomed" for e in doc["events"])
+
+
+def _poll_artifact(proc, flight, want, timeout_s=120.0):
+    """SIGUSR1-probe a live bench until its artifact satisfies ``want``."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise AssertionError(f"bench died early: rc={proc.returncode}")
+        proc.send_signal(signal.SIGUSR1)
+        time.sleep(0.5)
+        if os.path.exists(flight):
+            doc = json.load(open(flight))
+            if want(doc["events"]):
+                return doc
+    raise AssertionError("bench artifact never showed the wanted events")
+
+
+def test_bench_sigterm_leaves_flight_artifact_identifying_inflight_work(tmp_path):
+    """The acceptance bar: SIGTERM a bench mid-mode; the artifact's last
+    events name the in-flight mode (bench_mode_start with no end) and the
+    in-flight launch (a round/* compile or timing label)."""
+    flight = str(tmp_path / "flight.json")
+    proc = subprocess.Popen(
+        [
+            sys.executable, os.path.join(REPO, "bench.py"),
+            "--mode", "round", "--flight-recorder", flight,
+            "--pool", "1500", "--features", "6", "--trees", "5",
+            "--depth", "4", "--window", "10", "--iters", "1",
+            "--train-rows", "150", "--rounds-per-launch", "2",
+        ],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        text=True, cwd=REPO,
+    )
+    try:
+        # USR1's default disposition is terminate: only probe once the bench
+        # says its handlers are armed.
+        deadline = time.monotonic() + 120
+        while "flight recorder armed" not in proc.stderr.readline():
+            assert time.monotonic() < deadline, "bench never armed the recorder"
+
+        def _inflight_round(events):
+            started = any(
+                e["kind"] == "bench_mode_start" and e["mode"] == "round"
+                for e in events
+            )
+            launch = any(
+                e["kind"] in ("bench_compile", "bench_timing_start")
+                and str(e.get("label", "")).startswith("round/")
+                for e in events
+            )
+            return started and launch
+
+        _poll_artifact(proc, flight, _inflight_round)
+        proc.send_signal(signal.SIGTERM)
+        out, _err = proc.communicate(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()
+    # the JSON-always guarantee survives the kill (BENCH_r05's failure mode)
+    assert proc.returncode == 0
+    payload = json.loads([l for l in out.splitlines() if l.strip()][-1])
+    assert "BenchInterrupted" in payload["error"]
+    doc = json.load(open(flight))
+    assert "sigterm" in doc["reasons"]
+    events = doc["events"]
+    # in-flight mode: started, never ended
+    assert any(
+        e["kind"] == "bench_mode_start" and e["mode"] == "round" for e in events
+    )
+    assert not any(e["kind"] == "bench_mode_end" for e in events)
+    # in-flight launch: the last round/* marker has no later counterpart
+    labels = [
+        str(e.get("label", "")) for e in events
+        if e["kind"] in ("bench_compile", "bench_timing_start")
+    ]
+    assert labels and all(l.startswith("round/") for l in labels if l)

@@ -144,6 +144,7 @@ def test_neural_experiment_sharded_matches_unsharded(devices):
     )
 
 
+@pytest.mark.slow  # ~12s; the divisible sharded-matches-unsharded parity test stays tier-1
 def test_neural_experiment_sharded_pads_nondivisible_pool(devices):
     """A 250-row pool on an 8-way mesh pads to 256; padding rows must never be
     selected and labeled counts must track real rows only."""
@@ -153,6 +154,7 @@ def test_neural_experiment_sharded_pads_nondivisible_pool(devices):
     assert all(0.0 <= r.accuracy <= 1.0 for r in res.records)
 
 
+@pytest.mark.slow  # ~9s topology-variant resume; plain sharded + unsharded resume stay tier-1
 def test_neural_checkpoint_written_sharded_resumes_unsharded(tmp_path, devices):
     """Masks are stored over real rows only, so a checkpoint written under
     --mesh-data 8 (padded 250->256 pool) resumes on a single device — the mesh
@@ -175,6 +177,7 @@ def test_neural_mesh_model_axis_rejected():
         _run(_cfg(max_rounds=1, mesh=MeshConfig(data=4, model=2)))
 
 
+@pytest.mark.slow  # ~19s accuracy-evidence run; loop correctness stays covered by the parity tests
 def test_neural_al_accuracy_improves_over_rounds():
     """The deep-AL loop must actually *learn*: on the checkerboard pool the
     BALD curve rises from the seed-set accuracy to near-solved (round-2 gap:

@@ -1,0 +1,341 @@
+"""Roofline cost accounting (analysis/roofline.py) + the regression sentinel
+(benches/compare_bench.py): static flops/bytes extraction, measured-seconds
+attribution and its bound verdicts, the registry cost table, the JSONL
+``roofline`` event path, and the BENCH_r03->r04 acceptance diff."""
+
+import importlib.util
+import json
+import os
+import sys
+import types
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distributed_active_learning_tpu.analysis import roofline
+
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_by_path(name, relpath):
+    spec = importlib.util.spec_from_file_location(name, os.path.join(REPO, relpath))
+    mod = importlib.util.module_from_spec(spec)
+    # dataclasses resolves string annotations through sys.modules[cls.__module__]
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def compare_bench():
+    return _load_by_path("compare_bench", "benches/compare_bench.py")
+
+
+# ---------------------------------------------------------------------------
+# static cost extraction
+# ---------------------------------------------------------------------------
+
+
+def test_program_cost_of_matmul():
+    f = jax.jit(lambda a, b: jnp.dot(a, b))
+    a = jnp.ones((128, 128), jnp.float32)
+    cost = roofline.program_cost(f, a, a)
+    # 2*n^3 macs; XLA reports n^3 multiplies + n^2(n-1) adds — just pin the
+    # magnitude and the derived intensity, not the compiler's exact count.
+    assert 1e6 < cost["flops"] < 1e7
+    assert cost["bytes_accessed"] >= 3 * 128 * 128 * 4  # two inputs + output
+    assert cost["flops_per_byte"] == pytest.approx(
+        cost["flops"] / cost["bytes_accessed"], rel=1e-3
+    )
+
+
+def test_program_cost_accepts_abstract_args():
+    f = jax.jit(lambda a: a * 2.0 + 1.0)
+    cost = roofline.program_cost(
+        f, jax.ShapeDtypeStruct((64, 64), jnp.float32)
+    )
+    assert cost["flops"] and cost["bytes_accessed"]
+
+
+def test_compiled_cost_handles_unreportable_backend():
+    class Broken:
+        def cost_analysis(self):
+            raise RuntimeError("no cost model")
+
+    assert roofline.compiled_cost(Broken()) == {
+        "flops": None, "bytes_accessed": None,
+    }
+
+
+def test_cost_table_prices_registry_program_and_records_failures():
+    from distributed_active_learning_tpu.analysis.programs import (
+        SkipProgram,
+        build_registry,
+    )
+
+    specs = build_registry(
+        strategies=["uncertainty"], kinds=["chunk"], placements=["cpu"]
+    )
+    assert len(specs) == 1
+    table = roofline.cost_table(specs)
+    entry = table["chunk/uncertainty/cpu"]
+    assert entry["flops"] > 0 and entry["bytes_accessed"] > 0
+
+    def _raise_skip():
+        raise SkipProgram("no mesh here")
+
+    def _raise_err():
+        raise RuntimeError("builder broke")
+
+    fakes = [
+        types.SimpleNamespace(name="fake/skip", build=_raise_skip),
+        types.SimpleNamespace(name="fake/err", build=_raise_err),
+    ]
+    table2 = roofline.cost_table(fakes)
+    assert table2["fake/skip"] == {"skipped": "no mesh here"}
+    assert "builder broke" in table2["fake/err"]["error"]
+    # the human table renders every row shape without raising
+    rendered = roofline.render_cost_table({**table, **table2})
+    assert "chunk/uncertainty/cpu" in rendered and "(skipped)" in rendered
+
+
+# ---------------------------------------------------------------------------
+# attribution + verdicts
+# ---------------------------------------------------------------------------
+
+
+def test_attribute_verdicts_with_known_peaks():
+    # high intensity, fast: compute utilization dominates
+    c = {"flops": 1e12, "bytes_accessed": 1e9, "flops_per_byte": 1000.0}
+    a = roofline.attribute(
+        c, 0.01, peak_flops_per_sec=200e12, peak_bytes_per_sec=800e9
+    )
+    assert a["bound"] == "compute-bound"
+    assert a["mfu"] == pytest.approx(1e14 / 200e12, rel=1e-3)
+    # low intensity: bandwidth utilization dominates
+    c = {"flops": 1e9, "bytes_accessed": 1e10, "flops_per_byte": 0.1}
+    a = roofline.attribute(
+        c, 0.1, peak_flops_per_sec=200e12, peak_bytes_per_sec=800e9
+    )
+    assert a["bound"] == "bandwidth-bound"
+    assert a["bandwidth_util"] == pytest.approx(1e11 / 800e9, rel=1e-3)
+
+
+def test_attribute_without_seconds_gives_static_verdict():
+    c = {"flops": 1e12, "bytes_accessed": 1e9, "flops_per_byte": 1000.0}
+    a = roofline.attribute(
+        c, None, peak_flops_per_sec=200e12, peak_bytes_per_sec=800e9
+    )
+    assert a["mfu"] is None and a["achieved_gflops_per_sec"] is None
+    # static intensity (1000) vs machine balance (250): compute side
+    assert a["bound"] == "compute-bound(static)"
+
+
+def test_attribute_scales_peaks_by_mesh_devices():
+    c = {"flops": 1e12, "bytes_accessed": 1e9, "flops_per_byte": 1000.0}
+    one = roofline.attribute(
+        c, 0.01, peak_flops_per_sec=100e12, peak_bytes_per_sec=800e9
+    )
+    four = roofline.attribute(
+        c, 0.01, peak_flops_per_sec=100e12, peak_bytes_per_sec=800e9,
+        n_devices=4,
+    )
+    assert four["mfu"] == pytest.approx(one["mfu"] / 4, rel=1e-6)
+
+
+def test_attribute_on_cpu_names_the_missing_peak_table():
+    c = roofline.program_cost(
+        jax.jit(lambda a: a @ a), jnp.ones((32, 32), jnp.float32)
+    )
+    a = roofline.attribute(c, 0.001)  # default peaks: CPU has none
+    assert a["mfu"] is None
+    assert a["bound"] == "indeterminate:no-peak-table"
+
+
+def test_peak_tables_cover_same_chips():
+    assert set(roofline.PEAK_BF16_FLOPS) == set(roofline.PEAK_HBM_BYTES_PER_SEC)
+    peak, kind = roofline.peak_flops("TPU v5 lite rev2")
+    assert peak == 197e12 and kind == "TPU v5 lite rev2"
+    assert roofline.peak_bandwidth("CPU")[0] is None
+
+
+# ---------------------------------------------------------------------------
+# the JSONL roofline event path (emit_roofline + run.py --roofline)
+# ---------------------------------------------------------------------------
+
+
+def test_emit_roofline_event(tmp_path):
+    from distributed_active_learning_tpu.runtime import telemetry
+
+    path = str(tmp_path / "m.jsonl")
+    f = jax.jit(lambda a: a @ a)
+    a = jnp.ones((64, 64), jnp.float32)
+    with telemetry.MetricsWriter(path, rank=0) as w:
+        tracker = telemetry.LaunchTracker(w, "toy", fn=f)
+        tracker.record(2.0)   # "compile" call
+        tracker.record(0.25)
+        tracker.record(0.35)
+        attr = telemetry.emit_roofline(w, tracker, f, (a,))
+    assert attr is not None and attr["flops"] > 0
+    events = [json.loads(line) for line in open(path)]
+    ev = next(e for e in events if e["kind"] == "roofline")
+    assert ev["program"] == "toy" and ev["calls"] == 3
+    # steady mean excludes the first (compile) call: (0.25 + 0.35) / 2
+    assert ev["seconds"] == pytest.approx(0.3, rel=1e-6)
+    assert "bound" in ev and ev["flops"] > 0
+
+
+def test_emit_roofline_failure_degrades_to_error_event(tmp_path):
+    from distributed_active_learning_tpu.runtime import telemetry
+
+    path = str(tmp_path / "m.jsonl")
+
+    class NotJitted:
+        def lower(self, *a):
+            raise TypeError("nope")
+
+    with telemetry.MetricsWriter(path, rank=0) as w:
+        tracker = telemetry.LaunchTracker(w, "broken")
+        assert telemetry.emit_roofline(w, tracker, NotJitted(), ()) is None
+    events = [json.loads(line) for line in open(path)]
+    ev = next(e for e in events if e["kind"] == "roofline")
+    assert ev["program"] == "broken" and "nope" in ev["error"]
+
+
+@pytest.mark.slow  # ~8s CLI e2e; the emit_roofline unit path stays tier-1
+def test_run_cli_roofline_event_end_to_end(tmp_path):
+    from distributed_active_learning_tpu.run import main
+
+    path = str(tmp_path / "m.jsonl")
+    rc = main([
+        "--dataset", "checkerboard2x2", "--strategy", "uncertainty",
+        "--fit", "device", "--trees", "5", "--depth", "3",
+        "--rounds", "2", "--rounds-per-launch", "2", "--window", "10",
+        "--quiet", "--json", "--metrics-out", path, "--roofline",
+    ])
+    assert rc == 0
+    events = [json.loads(line) for line in open(path)]
+    roofs = [e for e in events if e["kind"] == "roofline"]
+    assert len(roofs) == 1
+    ev = roofs[0]
+    assert ev["program"] == "chunk_scan"
+    assert ev["flops"] > 0 and ev["bytes_accessed"] > 0
+    assert ev["seconds"] > 0 and "bound" in ev
+
+
+def test_summarize_metrics_roofline_section():
+    sm = _load_by_path("summarize_metrics", "benches/summarize_metrics.py")
+    events = [
+        {"ts": 1.0, "kind": "roofline", "program": "chunk_scan",
+         "flops": 2.5e9, "bytes_accessed": 1.0e9,
+         "achieved_gflops_per_sec": 125.0, "achieved_gbytes_per_sec": 50.0,
+         "mfu": 0.125, "bandwidth_util": 0.06, "bound": "compute-bound"},
+        {"ts": 1.1, "kind": "roofline", "program": "bad", "error": "boom"},
+    ]
+    out = sm.summarize(events)
+    assert "== roofline ==" in out
+    assert "chunk_scan" in out and "compute-bound" in out
+    assert "12.50%" in out  # mfu rendered as a percentage
+    assert "(error)" in out
+
+
+def test_summarize_metrics_serve_latency_by_cause():
+    sm = _load_by_path("summarize_metrics", "benches/summarize_metrics.py")
+    events = [
+        {"ts": 1.0 + 0.01 * i, "kind": "serve_latency", "seconds": 0.001,
+         "batch": 4, "cause": "none"}
+        for i in range(8)
+    ] + [
+        {"ts": 2.0, "kind": "serve_latency", "seconds": 0.5, "batch": 4,
+         "cause": "slab_growth_compile"},
+        {"ts": 2.1, "kind": "serve_latency", "seconds": 0.05, "batch": 4,
+         "cause": "refit_dispatch"},
+    ]
+    out = sm.summarize(events)
+    section = out.split("== serve latency ==")[1]
+    # the aggregate row plus one row per cause, spike attributed
+    for label in ("all", "none", "slab_growth_compile", "refit_dispatch"):
+        assert label in section
+    growth_row = next(
+        ln for ln in section.splitlines() if ln.startswith("slab_growth_compile")
+    )
+    assert "500.000" in growth_row  # the 0.5 s spike sits on the growth row
+
+
+# ---------------------------------------------------------------------------
+# the regression sentinel
+# ---------------------------------------------------------------------------
+
+
+def test_compare_r03_r04_names_the_mfu_regression(compare_bench):
+    base = compare_bench.load_payload(os.path.join(REPO, "BENCH_r03.json"))
+    cur = compare_bench.load_payload(os.path.join(REPO, "BENCH_r04.json"))
+    report = compare_bench.compare_payloads(base, cur)
+    assert report["verdict"].startswith("regression:")
+    assert "mfu" in report["regressions"]
+    mfu = next(f for f in report["findings"] if f["metric"] == "mfu")
+    assert mfu["status"] == "regression"
+    assert mfu["threshold_pct"] == 20.0 and mfu["change_pct"] < -70
+    rendered = compare_bench.render(report)
+    assert "REGRESSION" in rendered and "mfu" in rendered
+
+
+def test_compare_null_parsed_wrapper_is_a_named_load_error(compare_bench):
+    with pytest.raises(SystemExit, match="no parseable bench payload"):
+        compare_bench.load_payload(os.path.join(REPO, "BENCH_r05.json"))
+
+
+def test_compare_counter_is_hard_even_under_warn_only(
+    compare_bench, tmp_path, capsys
+):
+    base = {"metric": "serve_qps", "value": 100.0, "serve_qps": 100.0,
+            "recompiles_after_warmup": 0}
+    cur = {"metric": "serve_qps", "value": 99.0, "serve_qps": 99.0,
+           "recompiles_after_warmup": 2}
+    b, c = tmp_path / "b.json", tmp_path / "c.json"
+    b.write_text(json.dumps(base))
+    c.write_text(json.dumps(cur))
+    rc = compare_bench.main([str(b), str(c), "--warn-only"])
+    capsys.readouterr()
+    assert rc == 1  # any recompile increase is hard
+    # without the counter move, the same soft drift passes under --warn-only
+    cur2 = dict(cur, recompiles_after_warmup=0, serve_qps=60.0, value=60.0)
+    c.write_text(json.dumps(cur2))
+    assert compare_bench.main([str(b), str(c), "--warn-only"]) == 0
+    assert compare_bench.main([str(b), str(c)]) == 1  # strict mode fails
+    capsys.readouterr()
+
+
+def test_compare_improvement_and_threshold_override(compare_bench):
+    base = {"metric": "acquisition_scores_per_sec", "value": 100.0, "mfu": 0.10}
+    cur = {"metric": "acquisition_scores_per_sec", "value": 140.0, "mfu": 0.109}
+    report = compare_bench.compare_payloads(base, cur)
+    assert report["verdict"] == "improved"
+    tight = compare_bench.compare_payloads(
+        base, {"metric": "acquisition_scores_per_sec", "value": 95.0, "mfu": 0.10},
+        thresholds={"value": 0.01},
+    )
+    assert "value(acquisition_scores_per_sec)" in tight["regressions"]
+
+
+def test_compare_notes_smoke_size_mismatch(compare_bench):
+    base = {"metric": "al_round_seconds", "value": 1.0, "cpu_smoke_sizes": True}
+    cur = {"metric": "al_round_seconds", "value": 1.0}
+    report = compare_bench.compare_payloads(base, cur)
+    assert any("size tables differ" in n for n in report["notes"])
+
+
+def test_bench_compare_to_attaches_regression_verdict(tmp_path):
+    bench = _load_by_path("bench_for_compare", "bench.py")
+    baseline = tmp_path / "base.json"
+    baseline.write_text(json.dumps({
+        "metric": "al_round_seconds", "value": 0.5, "mfu": 0.2,
+    }))
+    payload = {"metric": "al_round_seconds", "value": 2.0, "mfu": 0.01}
+    out = bench._compare_to(str(baseline), payload)
+    assert out["verdict"].startswith("regression:")
+    assert "mfu" in out["regressions"]
+    missing = bench._compare_to(str(tmp_path / "nope.json"), payload)
+    assert "error" in missing

@@ -118,6 +118,7 @@ def test_sweep_staggered_windows_and_budget_stops():
     assert len(set(lengths)) > 1  # genuinely staggered stops
 
 
+@pytest.mark.slow  # ~22s; chunked + neural resume parity stay tier-1, the sweep resume joins the slow acceptance variants
 def test_sweep_checkpoint_resume_mid_sweep(tmp_path):
     """One sweepstate checkpoint covers all experiments; a resumed sweep
     continues each from its frozen round and lands on curves bit-identical

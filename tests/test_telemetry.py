@@ -72,6 +72,7 @@ def test_round_metrics_parity_fused_vs_per_round(strategy):
     _assert_metrics_equal(fused, base)
 
 
+@pytest.mark.slow  # ~14s; CPU fused-vs-per-round metrics parity stays tier-1, mesh chunk parity lives in test_chunked_driver
 def test_round_metrics_parity_on_sharded_mesh(devices):
     """Same parity on the 4x2 mesh: the metrics reductions are plain jnp ops,
     so GSPMD partitions them with the round — chunked-on-mesh must equal

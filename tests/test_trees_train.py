@@ -64,6 +64,7 @@ def test_device_fit_accuracy_near_sklearn_checkerboard():
     assert acc_dev >= acc_sk - 0.02, (acc_dev, acc_sk)
 
 
+@pytest.mark.slow  # ~16s accuracy-evidence twin; the checkerboard-shape sibling stays tier-1
 def test_device_fit_accuracy_near_sklearn_fraud_shape():
     """The credit-card-fraud workload shape (30 features, linear-ish signal)."""
     rng = np.random.default_rng(2)
