@@ -24,9 +24,12 @@ Baseline derivations:
   costs 28.48M tree-points / 3,242/s = 8,784 s; fit/shuffle time would add
   more, so using it as the round baseline is conservative.
 
-Default (no --mode) runs all five modes (score/density/round/lal/neural) and
-prints ONE JSON line whose headline is the scoring metric, with the
-round/LAL/neural/MFU numbers as additional keys.
+Default (no --mode) runs the full suite (score/density/round/sweep/grid/
+serve/lal/neural) and prints ONE JSON line whose headline is the scoring
+metric, with the round/sweep/grid/serve/LAL/neural/MFU numbers as additional
+keys. The sweep and grid modes' serial-comparison legs are optional
+(``--no-baseline``; auto-skipped near the ``--deadline`` with a
+``baseline_skipped`` record).
 
 Rig-health self-diagnosis (r4 lesson: the driver captured a 28x-degraded
 session and nothing in the artifact said so): every run probes a known-FLOPs
@@ -796,6 +799,154 @@ def _bench_pipelined(args, chunk_fn, state0, aux, binned, fit_key, tx, ty, K, wi
     }
 
 
+def _baseline_leg_ok(args, est_seconds):
+    """Whether a mode's serial-baseline comparison leg should run.
+
+    The baseline re-runs the pre-batching driver purely for the speedup
+    denominator — most of sweep/grid smoke's wall time. ``--no-baseline``
+    skips it outright; near the ``--deadline`` it is auto-skipped so the
+    measured leg's JSON always lands (the r05 lesson applied to the legs
+    INSIDE a mode). Returns ``(run_it, skip_record)`` — the record lands in
+    the payload under ``baseline_skipped`` so a missing ``*_speedup`` key is
+    explained, not just absent.
+    """
+    if getattr(args, "no_baseline", False):
+        return False, {"reason": "no_baseline_flag"}
+    deadline = getattr(args, "deadline", None)
+    t0 = getattr(args, "_start_time", None)
+    if deadline and t0 is not None:
+        elapsed = time.perf_counter() - t0
+        if elapsed + est_seconds > deadline:
+            return False, {
+                "reason": "deadline",
+                "elapsed_seconds": round(elapsed, 2),
+                "estimated_baseline_seconds": round(est_seconds, 2),
+                "deadline_seconds": deadline,
+            }
+    return True, None
+
+
+def bench_grid(args):
+    """Full-grid launch throughput vs the serial S x E loop (the PR-9
+    tentpole): strategies x seeds over one shared pool, driven two ways.
+
+    The grid leg runs ``runtime.sweep.run_grid`` — heterogeneous strategy
+    groups batched into ONE pipelined launch stream (one top-k per group,
+    masked merge, one compile for the whole matrix). The serial leg is the
+    status-quo S x E loop: ``run_experiment`` once per (strategy, seed),
+    each run paying its own chunk-closure trace + compile — exactly what
+    ``benches/run_deep_multiseed.sh``-style reproductions pay today.
+    ``grid_cells_rounds_per_second`` is the headline;
+    ``recompiles_after_warmup`` must stay 0 across the grid's launches (the
+    one-compile-for-the-matrix contract). The serial leg is optional
+    (``--no-baseline`` / auto-skipped near the deadline, recorded under
+    ``baseline_skipped``).
+    """
+    import dataclasses
+
+    from distributed_active_learning_tpu.config import (
+        DataConfig,
+        ExperimentConfig,
+        ForestConfig,
+        StrategyConfig,
+    )
+    from distributed_active_learning_tpu.data.datasets import DataBundle
+    from distributed_active_learning_tpu.runtime.loop import run_experiment
+    from distributed_active_learning_tpu.runtime.sweep import run_grid
+
+    strategies = [s.strip() for s in args.grid_strategies.split(",") if s.strip()]
+    E = args.grid_experiments
+    # K pinned at 2 (not the round-mode --rounds-per-launch default): the
+    # grid smoke measures LAUNCH/COMPILE economics — one compile + one
+    # stream for the matrix vs a compile per serial cell — and long chunks
+    # amortize the serial leg's compiles too, diluting exactly the effect
+    # under test. Two launches keep recompiles_after_warmup meaningful.
+    K = 2
+    n = args.sweep_pool
+    window = min(args.window, max(n // (8 * K), 1))
+    rounds = 2 * K
+
+    rng = np.random.default_rng(0)
+    pool = rng.normal(size=(n, args.features)).astype(np.float32)
+    pool_y = (pool[:, 0] + 0.3 * pool[:, 1] > 0).astype(np.int32)
+    test = rng.normal(size=(min(n, 2048), args.features)).astype(np.float32)
+    test_y = (test[:, 0] + 0.3 * test[:, 1] > 0).astype(np.int32)
+    bundle = DataBundle(
+        train_x=pool, train_y=pool_y, test_x=test, test_y=test_y,
+        name="bench_grid",
+    )
+
+    cfg = ExperimentConfig(
+        data=DataConfig(name="bench_grid"),
+        forest=ForestConfig(
+            n_trees=args.trees, max_depth=4, kernel=args.kernel, fit="device",
+            fit_budget=1 << (window + (rounds + 1) * window).bit_length(),
+        ),
+        strategy=StrategyConfig(name=strategies[0], window_size=window),
+        n_start=window,
+        max_rounds=rounds,
+        rounds_per_launch=K,
+        log_every=0,
+    )
+    seeds = list(range(E))
+    cells = len(strategies) * E
+
+    _flight("bench_timing_start", label="grid/run_grid", cells=cells)
+    t0 = time.perf_counter()
+    grid = run_grid(cfg, strategies, seeds, bundles={"bench_grid": bundle})
+    grid_sec = time.perf_counter() - t0
+    _flight("bench_timing_end", label="grid/run_grid", seconds=round(grid_sec, 3))
+
+    out = {
+        "grid_strategies": strategies,
+        "grid_seeds": E,
+        "grid_cells": cells,
+        "grid_rounds_per_launch": K,
+        "grid_rounds": rounds,
+        "grid_pool": n,
+        "grid_window": window,
+        "grid_seconds": round(grid_sec, 3),
+        "grid_cells_rounds_per_second": round(cells * rounds / grid_sec, 2),
+        "grid_launches": grid.launches,
+        "recompiles_after_warmup": grid.recompiles_after_warmup,
+        # --mode all merges serve's same-named counter over the bare key, so
+        # the grid contract also rides a namespaced twin the merge can't
+        # clobber (compare_bench gates both, hard).
+        "grid_recompiles_after_warmup": grid.recompiles_after_warmup,
+    }
+    # The serial S x E loop re-traces and re-compiles per cell; estimate it
+    # off the measured grid leg (observed CPU-smoke speedups are ~7x+, so 8x
+    # is a conservative don't-overrun guess for the deadline check).
+    run_baseline, skip = _baseline_leg_ok(args, est_seconds=grid_sec * 8.0)
+    if run_baseline:
+        _flight("bench_timing_start", label="grid/serial_loop", cells=cells)
+        t0 = time.perf_counter()
+        for s in strategies:
+            scfg = dataclasses.replace(
+                cfg, strategy=dataclasses.replace(cfg.strategy, name=s)
+            )
+            for e in seeds:
+                run_experiment(
+                    dataclasses.replace(scfg, seed=e), bundle=bundle
+                )
+        serial_sec = time.perf_counter() - t0
+        _flight(
+            "bench_timing_end", label="grid/serial_loop",
+            seconds=round(serial_sec, 3),
+        )
+        out["serial_cells_rounds_per_second"] = round(
+            cells * rounds / serial_sec, 2
+        )
+        out["grid_speedup"] = round(serial_sec / grid_sec, 2)
+    else:
+        # namespaced twin survives the --mode all merge, where sweep and grid
+        # both write the bare key (same collision class as
+        # grid_recompiles_after_warmup)
+        out["baseline_skipped"] = skip
+        out["grid_baseline_skipped"] = skip
+    return out
+
+
 def bench_sweep(args):
     """Batched-vs-serial experiment sweep throughput (the PR-5 tentpole).
 
@@ -853,23 +1004,33 @@ def bench_sweep(args):
     )
     seeds = list(range(E))
 
-    t0 = time.perf_counter()
-    for s in seeds:
-        run_experiment(dataclasses.replace(cfg, seed=s), bundle=bundle)
-    serial_sec = time.perf_counter() - t0
+    # Batched leg FIRST: the measured product number must land even when the
+    # deadline then skips the serial comparison leg (baseline_skipped).
     t0 = time.perf_counter()
     run_sweep(cfg, seeds, bundle=bundle)
     sweep_sec = time.perf_counter() - t0
     er = E * K
-    return {
+    out = {
         "sweep_experiments": E,
         "sweep_rounds_per_launch": K,
         "sweep_pool": n,
         "sweep_window": window,
         "sweep_experiments_rounds_per_second": round(er / sweep_sec, 2),
-        "serial_experiments_rounds_per_second": round(er / serial_sec, 2),
-        "sweep_speedup": round(serial_sec / sweep_sec, 2),
     }
+    run_baseline, skip = _baseline_leg_ok(args, est_seconds=sweep_sec * 8.0)
+    if run_baseline:
+        t0 = time.perf_counter()
+        for s in seeds:
+            run_experiment(dataclasses.replace(cfg, seed=s), bundle=bundle)
+        serial_sec = time.perf_counter() - t0
+        out["serial_experiments_rounds_per_second"] = round(er / serial_sec, 2)
+        out["sweep_speedup"] = round(serial_sec / sweep_sec, 2)
+    else:
+        # namespaced twin survives the --mode all merge (grid writes the
+        # same bare key)
+        out["baseline_skipped"] = skip
+        out["sweep_baseline_skipped"] = skip
+    return out
 
 
 def bench_serve(args):
@@ -1226,6 +1387,22 @@ def _run_mode(args) -> dict:
             "vs_baseline": None,
             **{k: v for k, v in r.items() if k != "cnn_round_seconds"},
         }
+    if args.mode == "grid":
+        r = _run_bench("grid", bench_grid, args)
+        return {
+            "metric": "grid_cells_rounds_per_second",
+            "value": r["grid_cells_rounds_per_second"],
+            "unit": (
+                f"cells*rounds/s ({r['grid_cells']} cells = "
+                f"{len(r['grid_strategies'])} strategies x {r['grid_seeds']} "
+                f"seeds, {r['grid_pool']} pool, one pipelined grid launch "
+                "stream vs the serial S x E loop)"
+            ),
+            "vs_baseline": None,
+            # the full key set rides too (the CI smoke job and compare_bench
+            # key on grid_cells_rounds_per_second / recompiles_after_warmup)
+            **r,
+        }
     if args.mode == "sweep":
         r = _run_bench("sweep", bench_sweep, args)
         return {
@@ -1291,8 +1468,8 @@ def _run_mode(args) -> dict:
     # round includes the roofline pricing compiles (device_round, fit, chunk
     # through the AOT path) on top of the timing bodies.
     _cpu_cost = {
-        "score": 30, "density": 25, "round": 280, "sweep": 90, "serve": 120,
-        "lal": 30, "neural": 260,
+        "score": 30, "density": 25, "round": 280, "sweep": 90, "grid": 150,
+        "serve": 120, "lal": 30, "neural": 260,
     }
 
     def want(name):
@@ -1385,6 +1562,9 @@ def _run_mode(args) -> dict:
     if want("sweep"):
         sw = _run_bench("sweep", bench_sweep, args)
         out.update(sw)
+    if want("grid"):
+        gr = _run_bench("grid", bench_grid, args)
+        out.update(gr)
     if want("serve"):
         sv = _run_bench("serve", bench_serve, args)
         out.update(sv)
@@ -1478,6 +1658,7 @@ _TPU_SIZES = dict(
     rounds_per_launch=8,
     sweep_experiments=8,
     sweep_pool=100_000,
+    grid_experiments=8,
     serve_queries=2000,
     serve_pool=8192,
 )
@@ -1493,6 +1674,7 @@ _CPU_SIZES = dict(
     rounds_per_launch=4,
     sweep_experiments=8,
     sweep_pool=500,
+    grid_experiments=8,
     serve_queries=220,
     serve_pool=256,
 )
@@ -1567,8 +1749,8 @@ def main():
     ap.add_argument(
         "--mode",
         choices=[
-            "all", "score", "density", "round", "sweep", "serve", "lal",
-            "neural",
+            "all", "score", "density", "round", "sweep", "grid", "serve",
+            "lal", "neural",
         ],
         default="all",
     )
@@ -1594,6 +1776,24 @@ def main():
     ap.add_argument(
         "--sweep-pool", type=int, default=None,
         help="sweep mode: shared pool rows (backend-resolved default)",
+    )
+    ap.add_argument(
+        "--grid-experiments", type=int, default=None,
+        help="grid mode: seeds per strategy in the batched grid launch "
+        "(backend-resolved default; cells = strategies x seeds)",
+    )
+    ap.add_argument(
+        "--grid-strategies", default="uncertainty,margin,density",
+        metavar="A,B,...",
+        help="grid mode: heterogeneous strategy groups batched into the one "
+        "launch stream",
+    )
+    ap.add_argument(
+        "--no-baseline", action="store_true",
+        help="sweep/grid modes: skip the serial-loop comparison leg (the "
+        "speedup denominator) — the batched measurement lands faster and "
+        "baseline_skipped records why the *_speedup keys are absent; near "
+        "the --deadline the skip is automatic",
     )
     ap.add_argument(
         "--serve-queries", type=int, default=None,

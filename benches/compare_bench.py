@@ -65,9 +65,11 @@ DEFAULT_SPECS: List[MetricSpec] = [
     MetricSpec("scan_fusion_speedup", "higher", 0.30),
     MetricSpec("pipelined_seconds_per_round", "lower", 0.30),
     MetricSpec("touchdown_hidden_fraction", "higher", 0.50),
-    # sweep / serve / lal / neural
+    # sweep / grid / serve / lal / neural
     MetricSpec("sweep_experiments_rounds_per_second", "higher", 0.30),
     MetricSpec("sweep_speedup", "higher", 0.30),
+    MetricSpec("grid_cells_rounds_per_second", "higher", 0.30),
+    MetricSpec("grid_speedup", "higher", 0.30),
     MetricSpec("serve_qps", "higher", 0.30),
     MetricSpec("serve_scores_per_sec", "higher", 0.30),
     MetricSpec("serve_p50_ms", "lower", 0.40),
@@ -79,6 +81,11 @@ DEFAULT_SPECS: List[MetricSpec] = [
     MetricSpec("transformer_batchbald_round_seconds", "lower", 0.40),
     # architectural counters: any increase is a fired invariant, not noise
     MetricSpec("recompiles_after_warmup", "lower", 0.0, kind="counter", hard=True),
+    # grid's namespaced twin: survives the --mode all merge where serve's
+    # bare counter overwrites grid's (bench.py bench_grid)
+    MetricSpec(
+        "grid_recompiles_after_warmup", "lower", 0.0, kind="counter", hard=True
+    ),
     MetricSpec("chunk_jit_cache_entries", "lower", 0.0, kind="counter"),
 ]
 
@@ -88,6 +95,7 @@ VALUE_DIRECTIONS = {
     "acquisition_scores_per_sec": "higher",
     "density_scores_per_sec": "higher",
     "sweep_experiments_rounds_per_second": "higher",
+    "grid_cells_rounds_per_second": "higher",
     "serve_qps": "higher",
     "al_round_seconds": "lower",
     "lal_query_seconds": "lower",

@@ -11,6 +11,10 @@ Sections:
 
 - **rounds** — count, labeled range, first/final accuracy, mean pool entropy
   drop (the in-scan RoundMetrics riding each ``round`` event);
+- **grid** — one grid launch's results matrix (round events tagged
+  strategy/dataset/seed by ``runtime/sweep.py run_grid``): per-(strategy,
+  dataset) final-accuracy mean +/- sd over seeds, frozen-cell counts, and
+  per-group rounds/s;
 - **phases** — total/mean wall seconds per phase (train/round/eval) where the
   per-round driver recorded them, the table the reference printed;
 - **launches** — compile-vs-execute split of the scan-fused chunk program and
@@ -173,6 +177,54 @@ def summarize(events: List[dict]) -> str:
             header.append("mean margin")
             row.append(f"{sum(margins) / len(margins):.5f}")
         out.append("\n== rounds ==\n" + _table(header, [row]))
+
+    # Grid-launch summary (runtime/sweep.py run_grid): round events carry
+    # strategy/dataset/seed tags, so one JSONL stream holds the whole paper
+    # results matrix. Two views: a per-(strategy, dataset) mean +/- sd band
+    # over final accuracies (the paper's table), and per-strategy throughput
+    # with frozen-cell counts (cells that stopped before the grid did).
+    grid_rounds = [e for e in rounds if "strategy" in e and "seed" in e]
+    if grid_rounds:
+        by_cell: Dict[tuple, list] = {}
+        for e in grid_rounds:
+            key = (str(e["strategy"]), str(e.get("dataset", "?")), e["seed"])
+            by_cell.setdefault(key, []).append(e)
+        max_rounds_seen = max(len(evs) for evs in by_cell.values())
+        group_rows = []
+        groups: Dict[tuple, list] = {}
+        for (strat, ds, _seed), evs in by_cell.items():
+            groups.setdefault((strat, ds), []).append(evs)
+        ts_all = [
+            e["ts"] for e in grid_rounds if isinstance(e.get("ts"), (int, float))
+        ]
+        span = (max(ts_all) - min(ts_all)) if len(ts_all) > 1 else 0.0
+        for (strat, ds), cell_evs in sorted(groups.items()):
+            finals = [
+                evs[-1].get("accuracy") for evs in cell_evs
+                if isinstance(evs[-1].get("accuracy"), (int, float))
+            ]
+            n_rounds = sum(len(evs) for evs in cell_evs)
+            frozen = sum(1 for evs in cell_evs if len(evs) < max_rounds_seen)
+            mean = sum(finals) / len(finals) if finals else None
+            sd = (
+                (sum((a - mean) ** 2 for a in finals) / len(finals)) ** 0.5
+                if finals else None
+            )
+            group_rows.append([
+                strat, ds, len(cell_evs),
+                f"{100 * mean:.2f} +/- {100 * sd:.2f}" if finals else "-",
+                frozen,
+                f"{n_rounds / span:.2f}" if span > 0 else "-",
+            ])
+        out.append(
+            "\n== grid ==\n"
+            + f"{len(by_cell)} cells, {len(grid_rounds)} cell-rounds\n"
+            + _table(
+                ["strategy", "dataset", "seeds", "final acc % (mean +/- sd)",
+                 "frozen", "rounds/s"],
+                group_rows,
+            )
+        )
 
     # Per-phase totals — the reference's TIMESTAMP table. Phase times appear
     # on round events when the per-round driver ran; the scan-fused driver

@@ -54,7 +54,10 @@ SWEEP_E = 3
 LABEL_CAP = 40
 FIT_BUDGET = 48
 
-KINDS = ("chunk", "sweep", "neural_chunk", "serve")
+KINDS = ("chunk", "sweep", "grid", "neural_sweep", "neural_chunk", "serve")
+GRID_D = 2   # datasets in the audited grid program
+GRID_E = 2   # seeds per (strategy, dataset)
+GRID_STRATEGIES = ("uncertainty", "margin", "density")  # heterogeneous groups
 PLACEMENTS = ("cpu", "mesh4x2")
 MESH_SHAPE = (4, 2)
 SERVE_BLOCK = 8
@@ -253,6 +256,139 @@ def _build_sweep(
     )
 
 
+def _build_grid(
+    strategy_name: str, placement: str, mesh_shape=MESH_SHAPE
+) -> AuditUnit:
+    """The full-grid chunk (runtime/sweep.py ``make_grid_chunk_fn``): three
+    heterogeneous strategy groups x 2 datasets x 2 seeds in one program,
+    with the dynamic per-dataset fill watermark and the masked test
+    accuracy both live (the richest variant the driver can build)."""
+    from distributed_active_learning_tpu.config import StrategyConfig
+    from distributed_active_learning_tpu.runtime.loop import make_grid_device_fit
+    from distributed_active_learning_tpu.runtime.sweep import (
+        SweepState,
+        make_grid_chunk_fn,
+    )
+    from distributed_active_learning_tpu.strategies import get_strategy
+
+    # "a+b+c" encodes the heterogeneous group set; the registry emits the
+    # fixed GRID_STRATEGIES spelling, specs_for_experiment the exact set a
+    # `run.py --strategies a,b,c --audit` invocation would launch.
+    group_names = tuple(strategy_name.split("+")) if strategy_name else GRID_STRATEGIES
+    mesh = _mesh_or_skip(mesh_shape) if placement != "cpu" else None
+    kernel = "pallas" if mesh is not None else "gemm"
+    strategies = [
+        get_strategy(StrategyConfig(name=n, window_size=WINDOW))
+        for n in group_names
+    ]
+    grid_fit = make_grid_device_fit(_forest_cfg(kernel), FIT_BUDGET, n_classes=2)
+    d, e = GRID_D, GRID_E
+    c = len(strategies) * d * e
+    grid_fn = make_grid_chunk_fn(
+        strategies, WINDOW, CHUNK_ROUNDS, grid_fit,
+        n_datasets=d,
+        n_seeds=e,
+        use_fill=True,
+        use_test_fill=True,
+        mesh=mesh,
+        wrap_pallas=mesh is not None,
+        with_metrics=True,
+        n_classes=2,
+    )
+    grid_state = SweepState(
+        labeled_mask=_sds((c, POOL_ROWS), jnp.bool_),
+        key=_key_sds((c,)),
+        round=_sds((c,), jnp.int32),
+    )
+    args = (
+        _sds((d, POOL_ROWS, FEATURES), jnp.int32),       # codes
+        _sds((d, POOL_ROWS, FEATURES), jnp.float32),     # x
+        _sds((d, POOL_ROWS), jnp.int32),                 # oracle_y
+        grid_state,                                       # donated carry
+        _sds((c, POOL_ROWS), jnp.bool_),                 # seed_masks
+        tuple(                                            # lal_forests
+            _abstract_lal_forest() if n == "lal" else None
+            for n in group_names
+        ),
+        _key_sds((c,)),                                   # fit_keys
+        _sds((c,), jnp.int32),                           # windows
+        _sds((d, TEST_ROWS, FEATURES), jnp.float32),     # test_x
+        _sds((d, TEST_ROWS), jnp.int32),                 # test_y
+        _sds((c,), jnp.int32),                           # end_rounds
+        _sds((c,), jnp.int32),                           # label_caps
+        _sds((d, FEATURES, MAX_BINS - 1), jnp.float32),  # edges
+        _sds((d,), jnp.int32),                           # n_valids
+        _sds((d,), jnp.int32),                           # test_ns
+    )
+    return AuditUnit(
+        name=f"grid/{'+'.join(group_names)}/{placement}",
+        fn=grid_fn,
+        args=args,
+        expect_donation=True,
+        with_metrics=True,
+        carry_in_argnums=(3,),
+        carry_out_index=0,
+    )
+
+
+def _stack_sds(tree, e: int):
+    """Add a leading [E] batch axis to every leaf of an abstract pytree —
+    the neural sweep's per-seed TrainState stacking, in aval form."""
+    return jax.tree.map(
+        lambda l: jax.ShapeDtypeStruct((e,) + tuple(l.shape), l.dtype), tree
+    )
+
+
+def _build_neural_sweep(strategy_name: str, placement: str) -> AuditUnit:
+    """The seed-batched neural chunk (runtime/neural_loop.py
+    ``make_neural_sweep_chunk_fn``): the TrainState carry batched [E] like
+    the mask, pool shared across the batch."""
+    from distributed_active_learning_tpu.models.neural import MLP, NeuralLearner
+    from distributed_active_learning_tpu.runtime.neural_loop import (
+        make_neural_sweep_chunk_fn,
+    )
+
+    if placement != "cpu":
+        raise SkipProgram(
+            "the neural loop shards pool rows only (mesh model > 1 is "
+            "refused by the driver); its traced program has no mesh variant"
+        )
+    learner = NeuralLearner(
+        MLP(n_classes=2, hidden=(8,)),
+        input_shape=(FEATURES,),
+        train_steps=2,
+        mc_samples=2,
+    )
+    chunk_fn = make_neural_sweep_chunk_fn(
+        learner, strategy_name, WINDOW, CHUNK_ROUNDS, LABEL_CAP,
+        with_metrics=True,
+        n_classes=2,
+    )
+    e = SWEEP_E
+    net_sds = _stack_sds(jax.eval_shape(learner.init, _key_sds()), e)
+    args = (
+        net_sds,                                      # net_states [E, ...]
+        _sds((e, POOL_ROWS), jnp.bool_),              # masks
+        _key_sds((e,)),                               # loop keys
+        _sds((e,), jnp.int32),                        # rounds
+        _sds((POOL_ROWS, FEATURES), jnp.float32),     # pool_x (shared)
+        _sds((POOL_ROWS,), jnp.int32),                # oracle_y (shared)
+        net_sds,                                      # init_nets [E, ...]
+        _sds((TEST_ROWS, FEATURES), jnp.float32),     # test_x
+        _sds((TEST_ROWS,), jnp.int32),                # test_y
+        _sds((e,), jnp.int32),                        # end_rounds
+    )
+    return AuditUnit(
+        name=f"neural_sweep/{strategy_name}/{placement}",
+        fn=chunk_fn,
+        args=args,
+        expect_donation=False,  # un-donated, matching the serial neural chunk
+        with_metrics=True,
+        carry_in_argnums=(0, 1, 2, 3),
+        carry_out_index=0,
+    )
+
+
 def _build_neural_chunk(strategy_name: str, placement: str) -> AuditUnit:
     from distributed_active_learning_tpu.models.neural import MLP, NeuralLearner
     from distributed_active_learning_tpu.runtime import state as state_lib
@@ -443,6 +579,11 @@ def build_registry(
     for kind, builder, names in (
         ("chunk", _build_chunk, forest_strategy_names()),
         ("sweep", _build_sweep, forest_strategy_names()),
+        # one fixed heterogeneous group set: the grid program's novelty is
+        # the multi-strategy merge itself, not per-strategy variants (each
+        # strategy's single-group program is already the sweep kind above)
+        ("grid", _build_grid, ["+".join(GRID_STRATEGIES)]),
+        ("neural_sweep", _build_neural_sweep, neural_strategy_names()),
         ("neural_chunk", _build_neural_chunk, neural_strategy_names()),
         ("serve", _build_serve, serve_program_names()),
     ):
@@ -453,7 +594,7 @@ def build_registry(
         # filter doesn't smuggle cpu programs back into the audit
         kind_placements = (
             (("cpu",) if "cpu" in placements else ())
-            if kind in ("neural_chunk", "serve")
+            if kind in ("neural_sweep", "neural_chunk", "serve")
             else placements
         )
         for name in names:
@@ -472,10 +613,19 @@ def build_registry(
     return specs
 
 
-def specs_for_experiment(cfg, neural_strategy: Optional[str] = None) -> List[ProgramSpec]:
+def specs_for_experiment(
+    cfg,
+    neural_strategy: Optional[str] = None,
+    grid_strategies: Optional[Sequence[str]] = None,
+    neural_sweep: bool = False,
+) -> List[ProgramSpec]:
     """The registry entries matching what ``run.py`` would launch for this
-    config: the neural chunk for a fusable deep strategy, the batched sweep
-    for ``sweep_seeds > 1``, the fused forest chunk otherwise (also the right
+    config: the neural chunk for a fusable deep strategy (the batched
+    neural_sweep program when ``neural_sweep`` — a ``--neural --sweep-seeds``
+    run launches that, not the serial chunk), the grid chunk for
+    ``--strategies a,b,c`` (``grid_strategies`` — the EXACT heterogeneous
+    group set, not the registry's fixed stand-in), the batched sweep for
+    ``sweep_seeds > 1``, the fused forest chunk otherwise (also the right
     audit surface for a per-round run — the chunk wraps the same round
     program).
 
@@ -497,8 +647,28 @@ def specs_for_experiment(cfg, neural_strategy: Optional[str] = None) -> List[Pro
             # shares the fit/predict pipeline
             name = "entropy"
         return build_registry(
-            strategies=[name], kinds=["neural_chunk"], placements=["cpu"]
+            strategies=[name],
+            kinds=["neural_sweep" if neural_sweep else "neural_chunk"],
+            placements=["cpu"],
         )
+    if grid_strategies:
+        joined = "+".join(grid_strategies)
+        shape = (cfg.mesh.data, cfg.mesh.model)
+        on_mesh = shape[0] * shape[1] > 1
+        if on_mesh and N_TREES % shape[1]:
+            shape = MESH_SHAPE  # inexpressible model width: the 4x2 stand-in
+        placement = f"mesh{shape[0]}x{shape[1]}" if on_mesh else "cpu"
+        return [
+            ProgramSpec(
+                name=f"grid/{joined}/{placement}",
+                kind="grid",
+                strategy=joined,
+                placement=placement,
+                build=functools.partial(
+                    _build_grid, joined, placement, mesh_shape=shape
+                ),
+            )
+        ]
     kind = "sweep" if getattr(cfg, "sweep_seeds", 1) > 1 else "chunk"
     if cfg.mesh.data * cfg.mesh.model <= 1:
         return build_registry(

@@ -28,15 +28,37 @@ from distributed_active_learning_tpu.config import (
 )
 
 
+# The paper's strategy abbreviations (PAPER.md §0 results matrix) accepted
+# anywhere a strategy is named on the CLI: "us" is uncertainty sampling.
+_STRATEGY_ALIASES = {"us": "uncertainty"}
+
+
 def build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(
         prog="distributed_active_learning_tpu.run",
         description="TPU-native pool-based active learning",
     )
     ap.add_argument("--dataset", default="checkerboard2x2")
+    ap.add_argument(
+        "--datasets", default=None, metavar="A,B,...",
+        help="comma-separated dataset list: with --sweep-seeds/--strategies "
+        "this adds a batched dataset axis to the grid launch (pools padded "
+        "to a common slab width, one compile shared across cells; "
+        "runtime/sweep.py run_grid). Overrides --dataset",
+    )
     ap.add_argument("--data-path", default=None, help="path for file-backed datasets")
     ap.add_argument("--n-samples", type=int, default=None, help="subsample the pool")
     ap.add_argument("--strategy", default="uncertainty")
+    ap.add_argument(
+        "--strategies", default=None, metavar="A,B,...",
+        help="comma-separated strategy list: run the whole strategies x "
+        "seeds (x datasets) grid as ONE pipelined launch stream — cells "
+        "grouped by scoring family, one top-k per group, masked merge "
+        "(runtime/sweep.py run_grid). Combine with --sweep-seeds N and "
+        "--datasets; per-cell records are bit-identical to the serial "
+        "S x E loop. Overrides --strategy; needs --fit device for the "
+        "batched path (host fit falls back to serial cells)",
+    )
     ap.add_argument("--window", type=int, default=10)
     ap.add_argument("--beta", type=float, default=1.0)
     ap.add_argument(
@@ -216,6 +238,7 @@ def _parse_strategy_options(pairs) -> dict:
 def main(argv=None) -> int:
     ap = build_parser()
     args = ap.parse_args(argv)
+    args.strategy = _STRATEGY_ALIASES.get(args.strategy, args.strategy)
 
     if args.list:
         from distributed_active_learning_tpu.data import available_datasets
@@ -271,25 +294,45 @@ def main(argv=None) -> int:
         ap.error(
             "checkpointing needs both --checkpoint-dir and --checkpoint-every"
         )
-    if args.stream_rounds and args.sweep_seeds > 1:
-        # The batched sweep chunk carries no in-scan stream callback (E
+    if args.stream_rounds and (
+        args.sweep_seeds > 1 or args.strategies or args.datasets
+    ):
+        # The batched sweep/grid chunks carry no in-scan stream callback (E
         # unordered per-experiment streams under vmap); refuse rather than
         # silently drop the user's requested live events.
         ap.error(
-            "--stream-rounds is not supported with --sweep-seeds > 1; "
-            "per-round events still arrive at every chunk touchdown via "
-            "--metrics-out"
+            "--stream-rounds is not supported with --sweep-seeds > 1 / "
+            "--strategies / --datasets; per-round events still arrive at "
+            "every chunk touchdown via --metrics-out"
         )
     # The neural (deep-AL) loop runs only when asked for explicitly: via
     # --neural or a namespaced "deep.*" strategy name. Names living in both
     # registries (e.g. "entropy") default to the classic forest path, which is
     # the reference-parity target (density_weighting.py:148).
     if args.neural or args.strategy.startswith("deep."):
-        if args.sweep_seeds > 1:
+        if args.strategies or args.datasets:
             ap.error(
-                "--sweep-seeds batches the forest loop's chunk program; the "
-                "neural path is not sweepable yet — loop over --seed instead"
+                "--strategies/--datasets drive the forest grid launcher; "
+                "the neural path batches the seed axis only (--sweep-seeds)"
             )
+        if args.sweep_seeds > 1:
+            from distributed_active_learning_tpu.runtime.neural_loop import (
+                FUSABLE_STRATEGIES,
+                _normalize_deep_name,
+            )
+
+            if _normalize_deep_name(args.strategy) not in FUSABLE_STRATEGIES:
+                ap.error(
+                    f"--sweep-seeds batches the fusable deep strategies "
+                    f"({', '.join(sorted(FUSABLE_STRATEGIES))}); "
+                    f"{args.strategy!r} unrolls a greedy per-round selection "
+                    "— loop over --seed instead"
+                )
+            if args.checkpoint_dir:
+                ap.error(
+                    "checkpointing is not supported by the batched neural "
+                    "sweep; run the seeds serially"
+                )
         if args.mesh_model != 1:
             ap.error(
                 "the neural path shards pool rows only (--mesh-data); "
@@ -310,7 +353,11 @@ def main(argv=None) -> int:
                 _normalize_deep_name,
             )
 
-            _audit_or_die(args, neural_strategy=_normalize_deep_name(args.strategy))
+            _audit_or_die(
+                args,
+                neural_strategy=_normalize_deep_name(args.strategy),
+                neural_sweep=args.sweep_seeds > 1,
+            )
         writer = _make_writer(args)
         try:
             with _profile(args):
@@ -318,7 +365,11 @@ def main(argv=None) -> int:
         finally:
             if writer is not None:
                 writer.close()
-        _emit(args, result, dbg)
+        if args.sweep_seeds > 1:
+            seeds = list(range(args.seed, args.seed + args.sweep_seeds))
+            _emit_sweep(args, result, seeds, dbg)
+        else:
+            _emit(args, result, dbg)
         _flight_exit_dump(args)
         return 0
 
@@ -334,9 +385,42 @@ def main(argv=None) -> int:
             f"'deep.{args.strategy}' (or pass --neural)"
         )
 
+    # Grid axes (--strategies / --datasets): comma lists routed through the
+    # grid launcher; the base cfg carries the first entry of each axis so
+    # config-derived identities (fit budget defaults, fingerprints) anchor on
+    # a real cell.
+    grid_strategies = (
+        [
+            _STRATEGY_ALIASES.get(s.strip(), s.strip())
+            for s in args.strategies.split(",") if s.strip()
+        ]
+        if args.strategies else None
+    )
+    grid_datasets = (
+        [d.strip() for d in args.datasets.split(",") if d.strip()]
+        if args.datasets else None
+    )
+    if grid_strategies is not None:
+        unknown = [
+            s for s in grid_strategies if s not in available_strategies()
+        ]
+        if unknown:
+            ap.error(
+                f"unknown strategies {unknown}; the grid launcher drives the "
+                f"classic registry: {', '.join(available_strategies())}"
+            )
+        if len(set(grid_strategies)) != len(grid_strategies):
+            # Post-alias duplicates ("us,uncertainty") would run identical
+            # groups and overwrite each other's per-cell output files.
+            ap.error(
+                f"duplicate strategies in --strategies: {grid_strategies}"
+            )
+    if grid_datasets is not None and len(set(grid_datasets)) != len(grid_datasets):
+        ap.error(f"duplicate datasets in --datasets: {grid_datasets}")
+
     cfg = ExperimentConfig(
         data=DataConfig(
-            name=args.dataset,
+            name=grid_datasets[0] if grid_datasets else args.dataset,
             path=args.data_path,
             n_samples=args.n_samples,
             seed=args.seed,
@@ -345,7 +429,7 @@ def main(argv=None) -> int:
             n_trees=args.trees, max_depth=args.depth, kernel=args.kernel, fit=args.fit
         ),
         strategy=StrategyConfig(
-            name=args.strategy,
+            name=grid_strategies[0] if grid_strategies else args.strategy,
             window_size=args.window,
             beta=args.beta,
             options=_parse_strategy_options(args.strategy_option),
@@ -364,12 +448,33 @@ def main(argv=None) -> int:
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every=args.checkpoint_every,
     )
+    use_grid = grid_strategies is not None or grid_datasets is not None
     if args.audit:
-        _audit_or_die(args, cfg=cfg)
+        # A --datasets-only (or single-entry --strategies) invocation still
+        # launches the grid program, so the audit must trace the grid chunk —
+        # the same group list run_grid receives — not the chunk/sweep one.
+        _audit_or_die(
+            args, cfg=cfg,
+            grid_strategies=(
+                (grid_strategies or [cfg.strategy.name]) if use_grid else None
+            ),
+        )
     writer = _make_writer(args)
     try:
         with _profile(args):
-            if args.sweep_seeds > 1:
+            if use_grid:
+                from distributed_active_learning_tpu.runtime.sweep import run_grid
+
+                seeds = list(range(args.seed, args.seed + args.sweep_seeds))
+                grid = run_grid(
+                    cfg,
+                    grid_strategies or [cfg.strategy.name],
+                    seeds,
+                    datasets=grid_datasets,
+                    debugger=dbg,
+                    metrics=writer,
+                )
+            elif args.sweep_seeds > 1:
                 from distributed_active_learning_tpu.runtime.sweep import run_sweep
 
                 seeds = list(range(args.seed, args.seed + args.sweep_seeds))
@@ -379,7 +484,9 @@ def main(argv=None) -> int:
     finally:
         if writer is not None:
             writer.close()
-    if args.sweep_seeds > 1:
+    if use_grid:
+        _emit_grid(args, grid, dbg)
+    elif args.sweep_seeds > 1:
         _emit_sweep(args, results, seeds, dbg)
     else:
         _emit(args, result, dbg)
@@ -397,7 +504,10 @@ def _flight_exit_dump(args) -> None:
         telemetry.flight_dump("exit")
 
 
-def _audit_or_die(args, cfg=None, neural_strategy=None):
+def _audit_or_die(
+    args, cfg=None, neural_strategy=None, grid_strategies=None,
+    neural_sweep=False,
+):
     """``--audit``: trace the fused program this configuration would launch
     (plus the recompile-hazard lint over the driver surfaces) and refuse to
     run on any error-severity finding. A mesh placement that cannot be
@@ -410,28 +520,43 @@ def _audit_or_die(args, cfg=None, neural_strategy=None):
         specs_for_experiment,
     )
 
-    specs = specs_for_experiment(cfg, neural_strategy=neural_strategy)
+    specs = specs_for_experiment(
+        cfg, neural_strategy=neural_strategy, grid_strategies=grid_strategies,
+        neural_sweep=neural_sweep,
+    )
     report = run_audit(specs)
     if not report.programs and report.skipped:
         # every spec was skipped (mesh placement, too few devices): re-audit
-        # the same strategy/kind at the cpu placement instead of gating
-        # nothing — and SAY so, since the traced program then differs from
-        # the one the run launches
-        from distributed_active_learning_tpu.analysis import build_registry
-
+        # the same launch at the cpu placement instead of gating nothing —
+        # and SAY so, since the traced program then differs from the one the
+        # run launches. Rebuilt through specs_for_experiment (mesh forced to
+        # 1x1) rather than a registry name filter: a custom grid group set
+        # ("uncertainty+margin") has no registry entry, so filtering the
+        # fixed-name registry would audit zero programs and pass silently.
         print(
             "# audit: mesh program unavailable here "
             f"({'; '.join(report.skipped.values())}); auditing the "
             "single-device program instead",
             file=sys.stderr,
         )
-        report = run_audit(
-            build_registry(
+        if cfg is not None:
+            import dataclasses
+
+            cpu_specs = specs_for_experiment(
+                dataclasses.replace(cfg, mesh=MeshConfig(data=1, model=1)),
+                neural_strategy=neural_strategy,
+                grid_strategies=grid_strategies,
+                neural_sweep=neural_sweep,
+            )
+        else:
+            from distributed_active_learning_tpu.analysis import build_registry
+
+            cpu_specs = build_registry(
                 strategies=sorted({s.strategy for s in specs}),
                 kinds=sorted({s.kind for s in specs}),
                 placements=["cpu"],
             )
-        )
+        report = run_audit(cpu_specs)
     report.extend(lint_paths(default_lint_targets()))
     if report.findings:
         print(report.render_table(), file=sys.stderr)
@@ -557,6 +682,18 @@ def _run_neural(args, dbg, metrics=None):
     )
     # Dataset identity feeds the checkpoint fingerprint, so a resume against a
     # different dataset/subsample is refused (same guard as the forest loop).
+    if args.sweep_seeds > 1:
+        from distributed_active_learning_tpu.runtime.neural_loop import (
+            run_neural_sweep,
+        )
+
+        return run_neural_sweep(
+            cfg, learner, bundle.train_x, bundle.train_y,
+            bundle.test_x, bundle.test_y,
+            seeds=list(range(args.seed, args.seed + args.sweep_seeds)),
+            debugger=dbg, data_ident=dataclasses.asdict(data_cfg),
+            metrics=metrics,
+        )
     return run_neural_experiment(
         cfg, learner, bundle.train_x, bundle.train_y, bundle.test_x, bundle.test_y,
         debugger=dbg, data_ident=dataclasses.asdict(data_cfg), metrics=metrics,
@@ -596,6 +733,68 @@ def _emit_sweep(args, results, seeds, dbg):
         print(
             f"# sweep final: {len(seeds)} seeds, accuracy "
             f"{np.mean(finals) * 100:.2f}% +/- {np.std(finals) * 100:.2f}%, "
+            f"total {dbg.total_time():.1f}s",
+            file=sys.stderr,
+        )
+
+
+def _emit_grid(args, grid, dbg):
+    """Per-cell emission for a grid launch: stdout logs under '# grid cell'
+    headers, --out as per-cell files, --plot as per-strategy x dataset
+    mean +/- sd bands (the paper's results-matrix figure from ONE run)."""
+    import dataclasses as dc
+
+    from distributed_active_learning_tpu.runtime.sweep import _grid_result_path
+
+    datasets = sorted({c.dataset for c in grid.cells})
+    with_ds = len(datasets) > 1
+    for cell in grid.cells:
+        if args.json:
+            for r in cell.result.records:
+                sys.stdout.write(
+                    json.dumps({
+                        "strategy": cell.strategy,
+                        "dataset": cell.dataset,
+                        "seed": cell.seed,
+                        **dc.asdict(r),
+                    }) + "\n"
+                )
+        else:
+            sys.stdout.write(
+                f"# grid cell {cell.strategy}/{cell.dataset}/seed {cell.seed}\n"
+            )
+            sys.stdout.write(cell.result.to_reference_log())
+        if args.out:
+            cell.result.save(
+                _grid_result_path(
+                    args.out, cell.strategy, cell.dataset, cell.seed, with_ds
+                ),
+                fmt="reference",
+            )
+    if args.plot:
+        from distributed_active_learning_tpu.runtime.results import plot_grid_bands
+
+        plot_grid_bands(grid, args.plot, title=f"grid ({len(grid.cells)} cells)")
+    if not args.quiet:
+        import numpy as np
+
+        finals = [
+            c.result.final_accuracy
+            for c in grid.cells
+            if c.result.final_accuracy is not None
+        ]
+        strategies = sorted({c.strategy for c in grid.cells})
+        acc = (
+            f"accuracy {np.mean(finals) * 100:.2f}% +/- "
+            f"{np.std(finals) * 100:.2f}%"
+            if finals else "no accuracy records"
+        )
+        print(
+            f"# grid final: {len(grid.cells)} cells "
+            f"({len(strategies)} strategies x {len(datasets)} datasets), "
+            f"{acc}, "
+            f"launches={grid.launches} "
+            f"recompiles_after_warmup={grid.recompiles_after_warmup}, "
             f"total {dbg.total_time():.1f}s",
             file=sys.stderr,
         )

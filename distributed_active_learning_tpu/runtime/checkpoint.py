@@ -324,28 +324,24 @@ def sweep_fingerprint(cfg, seeds, windows) -> str:
     return fingerprint_from_ident(ident)
 
 
-def save_sweep(
+def _save_batched(
     ckpt_dir: str,
+    prefix: str,
     masks,
     key_data,
     rounds,
     results,
-    n_valid: int,
-    fingerprint: Optional[str] = None,
+    n_cols: int,
+    fingerprint: Optional[str],
 ) -> Optional[str]:
-    """Write one checkpoint covering all E experiments of a batched sweep.
-
-    ``masks [E, n]`` / ``key_data`` / ``rounds [E]`` are the sweep carry's
-    donation-safe snapshot (``runtime.loop.ckpt_snapshot`` over the batched
-    state); per-experiment records serialize as a list of record lists. The
-    step number is the MAX round across experiments (the furthest-ahead
-    experiment — finished experiments' rounds freeze, so once every
-    experiment has stopped, later saves overwrite that same step file).
-    Primary-process-only under multi-host, like :func:`save`.
-    """
+    """Shared body of :func:`save_sweep` / :func:`save_grid`: one npz file
+    covering every row (experiment or grid cell) of a batched launch. The
+    step number is the MAX round across rows (finished rows' rounds freeze,
+    so once every row has stopped, later saves overwrite that same step
+    file). Primary-process-only under multi-host, like :func:`save`."""
     from distributed_active_learning_tpu.parallel.multihost import host_np
 
-    masks_np = host_np(masks)[:, :n_valid]  # collective: all ranks
+    masks_np = host_np(masks)[:, :n_cols]  # collective: all ranks
     payload = {
         "labeled_mask": masks_np,
         "key": np.asarray(key_data),
@@ -367,18 +363,102 @@ def save_sweep(
     from distributed_active_learning_tpu.utils.io import atomic_savez
 
     step = int(np.asarray(rounds).max())
-    return atomic_savez(os.path.join(ckpt_dir, f"sweepstate_{step}.npz"), **payload)
+    return atomic_savez(os.path.join(ckpt_dir, f"{prefix}_{step}.npz"), **payload)
 
 
-def latest_sweep_step(ckpt_dir: str) -> Optional[int]:
+def _latest_batched_step(ckpt_dir: str, step_re) -> Optional[int]:
     if not os.path.isdir(ckpt_dir):
         return None
     steps = [
         int(m.group(1))
         for fn in os.listdir(ckpt_dir)
-        if (m := _SWEEP_STEP_RE.match(fn))
+        if (m := step_re.match(fn))
     ]
     return max(steps) if steps else None
+
+
+def _restore_latest_batched(
+    ckpt_dir: str,
+    prefix: str,
+    step_re,
+    n_cols: int,
+    n_rows: int,
+    fingerprint: Optional[str],
+    kind: str,
+    row_noun: str,
+    width_noun: str,
+    width_target: str,
+):
+    """Shared body of :func:`restore_latest_sweep` / :func:`restore_latest_grid`.
+
+    Returns ``(masks [n_rows, n_cols], key_data, rounds [n_rows], results)``
+    as host arrays + one :class:`ExperimentResult` per row, or ``None`` if no
+    checkpoint exists. A fingerprint or shape mismatch raises — resuming a
+    different launch's state positionally would silently cross-wire every
+    row. ``kind``/``row_noun``/``width_noun``/``width_target`` keep the
+    per-format error wording ("sweep ... experiments" vs "grid ... cells")."""
+    step = _latest_batched_step(ckpt_dir, step_re)
+    if step is None:
+        return None
+    with np.load(os.path.join(ckpt_dir, f"{prefix}_{step}.npz")) as z:
+        stored_fp = (
+            bytes(z["config_fingerprint"]).decode()
+            if "config_fingerprint" in z.files
+            else None
+        )
+        if fingerprint is not None and stored_fp is not None and stored_fp != fingerprint:
+            raise ValueError(
+                f"{kind} checkpoint fingerprint {stored_fp} != current {kind} "
+                f"{fingerprint}: refusing to resume a different {kind}'s state"
+            )
+        masks = z["labeled_mask"]
+        key_data = z["key"]
+        rounds = z["round"]
+        records = json.loads(bytes(z["records_json"]).decode())
+    if masks.shape[0] != n_rows:
+        raise ValueError(
+            f"{kind} checkpoint holds {masks.shape[0]} {row_noun}, the "
+            f"current {kind} has {n_rows}"
+        )
+    if masks.shape[1] != n_cols:
+        raise ValueError(
+            f"{kind} checkpoint {width_noun} ({masks.shape[1]},) != "
+            f"{width_target} ({n_cols},)"
+        )
+    known = {f.name for f in dataclasses.fields(RoundRecord)}
+    results = [
+        ExperimentResult(
+            records=[RoundRecord(**{k: v for k, v in r.items() if k in known})
+                     for r in recs]
+        )
+        for recs in records
+    ]
+    return masks, key_data, rounds, results
+
+
+def save_sweep(
+    ckpt_dir: str,
+    masks,
+    key_data,
+    rounds,
+    results,
+    n_valid: int,
+    fingerprint: Optional[str] = None,
+) -> Optional[str]:
+    """Write one checkpoint covering all E experiments of a batched sweep.
+
+    ``masks [E, n]`` / ``key_data`` / ``rounds [E]`` are the sweep carry's
+    donation-safe snapshot (``runtime.loop.ckpt_snapshot`` over the batched
+    state); per-experiment records serialize as a list of record lists.
+    """
+    return _save_batched(
+        ckpt_dir, "sweepstate", masks, key_data, rounds, results, n_valid,
+        fingerprint,
+    )
+
+
+def latest_sweep_step(ckpt_dir: str) -> Optional[int]:
+    return _latest_batched_step(ckpt_dir, _SWEEP_STEP_RE)
 
 
 def restore_latest_sweep(
@@ -394,43 +474,86 @@ def restore_latest_sweep(
     shape mismatch raises — resuming a different sweep's state positionally
     would silently cross-wire every experiment.
     """
-    step = latest_sweep_step(ckpt_dir)
-    if step is None:
-        return None
-    with np.load(os.path.join(ckpt_dir, f"sweepstate_{step}.npz")) as z:
-        stored_fp = (
-            bytes(z["config_fingerprint"]).decode()
-            if "config_fingerprint" in z.files
-            else None
-        )
-        if fingerprint is not None and stored_fp is not None and stored_fp != fingerprint:
-            raise ValueError(
-                f"sweep checkpoint fingerprint {stored_fp} != current sweep "
-                f"{fingerprint}: refusing to resume a different sweep's state"
-            )
-        masks = z["labeled_mask"]
-        key_data = z["key"]
-        rounds = z["round"]
-        records = json.loads(bytes(z["records_json"]).decode())
-    if masks.shape[0] != n_experiments:
-        raise ValueError(
-            f"sweep checkpoint holds {masks.shape[0]} experiments, the "
-            f"current sweep has {n_experiments}"
-        )
-    if masks.shape[1] != n_valid:
-        raise ValueError(
-            f"sweep checkpoint pool size ({masks.shape[1]},) != experiment "
-            f"pool ({n_valid},)"
-        )
-    known = {f.name for f in dataclasses.fields(RoundRecord)}
-    results = [
-        ExperimentResult(
-            records=[RoundRecord(**{k: v for k, v in r.items() if k in known})
-                     for r in recs]
-        )
-        for recs in records
-    ]
-    return masks, key_data, rounds, results
+    return _restore_latest_batched(
+        ckpt_dir, "sweepstate", _SWEEP_STEP_RE, n_valid, n_experiments,
+        fingerprint, kind="sweep", row_noun="experiments",
+        width_noun="pool size", width_target="experiment pool",
+    )
+
+
+_GRID_STEP_RE = re.compile(r"^gridstate_(\d+)\.npz$")
+
+
+def grid_fingerprint(cfg, strategies, seeds, datasets, windows) -> str:
+    """Identity hash of a grid launch (runtime/sweep.py ``run_grid``): the
+    sweep fingerprint extended with the strategy and dataset axes. The file
+    stores every cell's state positionally in (strategy, dataset, seed)
+    order, so a grid checkpoint must only resume the SAME grid — same axes,
+    same order. The base identity drops the strategy/data names (they live
+    in the axes) but keeps the forest/seeding/loop identity fields."""
+    ident = _forest_ident(cfg, with_mesh=False)
+    # The anchor cfg carries the FIRST entry of each axis (run.py anchors
+    # config-derived identities on a real cell); hashing those copies would
+    # refuse a positionally-identical grid anchored on a different cell.
+    # Shared identity (beta/options, data path/subsampling, n_start) stays.
+    ident["strategy"].pop("name", None)
+    ident["strategy"].pop("window_size", None)
+    ident["data"].pop("name", None)
+    ident.pop("seed", None)
+    ident["grid"] = {
+        "strategies": [str(s) for s in strategies],
+        "seeds": [int(s) for s in seeds],
+        "datasets": [str(d) for d in datasets],
+        "windows": [int(w) for w in windows],
+    }
+    return fingerprint_from_ident(ident)
+
+
+def save_grid(
+    ckpt_dir: str,
+    masks,
+    key_data,
+    rounds,
+    results,
+    n_store: int,
+    fingerprint: Optional[str] = None,
+) -> Optional[str]:
+    """One checkpoint covering every cell of a grid launch.
+
+    ``masks [C, n_slab]`` / ``key_data`` / ``rounds [C]`` are the grid
+    carry's donation-safe snapshot; masks are sliced to ``n_store`` (the
+    common pad width BEFORE mesh padding) so a grid checkpointed under one
+    mesh resumes under another, like every other format here.
+    """
+    return _save_batched(
+        ckpt_dir, "gridstate", masks, key_data, rounds, results, n_store,
+        fingerprint,
+    )
+
+
+def latest_grid_step(ckpt_dir: str) -> Optional[int]:
+    return _latest_batched_step(ckpt_dir, _GRID_STEP_RE)
+
+
+def restore_latest_grid(
+    ckpt_dir: str,
+    n_store: int,
+    n_cells: int,
+    fingerprint: Optional[str] = None,
+):
+    """Load the newest grid checkpoint; ``None`` if none exists.
+
+    Returns ``(masks [C, n_store], key_data, rounds [C], results)`` as host
+    arrays + one :class:`ExperimentResult` per cell. A fingerprint or shape
+    mismatch raises — resuming a different grid's state positionally would
+    silently cross-wire every cell (same contract as
+    :func:`restore_latest_sweep`).
+    """
+    return _restore_latest_batched(
+        ckpt_dir, "gridstate", _GRID_STEP_RE, n_store, n_cells,
+        fingerprint, kind="grid", row_noun="cells",
+        width_noun="pool width", width_target="grid slab",
+    )
 
 
 _SERVE_STEP_RE = re.compile(r"^servestate_(\d+)\.npz$")

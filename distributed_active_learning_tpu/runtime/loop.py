@@ -168,6 +168,29 @@ def _accuracy(forest, test_x: jnp.ndarray, test_y: jnp.ndarray) -> jnp.ndarray:
         return jnp.mean((pred == test_y).astype(jnp.float32))
 
 
+@jax.jit
+def _accuracy_masked(
+    forest, test_x: jnp.ndarray, test_y: jnp.ndarray, test_n: jnp.ndarray
+) -> jnp.ndarray:
+    """:func:`_accuracy` over the first ``test_n`` rows of a padded test set.
+
+    The grid launcher pads per-dataset test sets to a common slab width so
+    the vmapped accuracy pass keeps one static shape; padding rows must not
+    dilute the mean. With ``test_n == test_x.shape[0]`` (no padding) the
+    masked sum/count equals the plain mean — but the grid driver routes
+    that case to :func:`_accuracy` anyway so equal-width grids share the
+    serial program bit-for-bit."""
+    from distributed_active_learning_tpu.ops import trees_multi
+
+    with jax.named_scope("al/eval"):
+        if trees_multi.is_multi(forest):
+            pred = trees_multi.predict_class(forest, test_x)
+        else:
+            pred = (forest_eval.proba(forest, test_x) > 0.5).astype(jnp.int32)
+        ok = (pred == test_y) & (jnp.arange(test_y.shape[0]) < test_n)
+        return jnp.sum(ok.astype(jnp.float32)) / test_n.astype(jnp.float32)
+
+
 def _labeled_subset(
     state: state_lib.PoolState,
     host_x: Optional[np.ndarray] = None,
@@ -211,12 +234,12 @@ def _resolve_fit_budget(cfg: ExperimentConfig, n_pool: int, n_labeled: int) -> i
     return min(caps)
 
 
-def make_device_fit(
-    cfg: ExperimentConfig, edges: jnp.ndarray, budget: int, n_classes: int = 2
-):
-    """Jitted device train phase: labeled-window gather + histogram fit +
-    kernel-form conversion, all in one XLA program (no host round-trip —
-    the replacement for the JVM fit at ``uncertainty_sampling.py:71-76``)."""
+def _device_fit_core(cfg: ExperimentConfig, budget: int, n_classes: int):
+    """The traced body shared by :func:`make_device_fit` (edges closed over)
+    and :func:`make_grid_device_fit` (edges as a per-call argument): one
+    labeled-window gather + histogram fit + kernel-form conversion. A single
+    definition so the two entry points cannot drift — grid cells and serial
+    runs must fit bit-identically."""
     from distributed_active_learning_tpu.ops import trees_train
 
     fc = cfg.forest
@@ -238,8 +261,7 @@ def make_device_fit(
             )
         return PallasForest(gf=forest)
 
-    @jax.jit
-    def fit(codes: jnp.ndarray, state: state_lib.PoolState, key: jax.Array):
+    def fit_body(codes, edges, state: state_lib.PoolState, key: jax.Array):
         with jax.named_scope("al/fit"):
             mask = state.labeled_mask & state.valid_mask
             c, yy, w = trees_train.gather_fit_window(codes, state.oracle_y, mask, budget)
@@ -252,6 +274,43 @@ def make_device_fit(
                 gf = trees_train.heap_gemm_forest(f, th, v, fc.max_depth)
                 return _wrap_pallas(gf) if fc.kernel == "pallas" else gf
             return trees_train.heap_packed_forest(f, th, v, fc.max_depth)
+
+    return fit_body
+
+
+def make_device_fit(
+    cfg: ExperimentConfig, edges: jnp.ndarray, budget: int, n_classes: int = 2
+):
+    """Jitted device train phase: labeled-window gather + histogram fit +
+    kernel-form conversion, all in one XLA program (no host round-trip —
+    the replacement for the JVM fit at ``uncertainty_sampling.py:71-76``)."""
+    fit_body = _device_fit_core(cfg, budget, n_classes)
+
+    @jax.jit
+    def fit(codes: jnp.ndarray, state: state_lib.PoolState, key: jax.Array):
+        return fit_body(codes, edges, state, key)
+
+    return fit
+
+
+def make_grid_device_fit(cfg: ExperimentConfig, budget: int, n_classes: int = 2):
+    """:func:`make_device_fit` with the bin edges as a per-call argument.
+
+    The grid launcher (runtime/sweep.py ``make_grid_chunk_fn``) stacks one
+    binning per dataset along a leading ``[D]`` axis and hands each cell its
+    own edges through the vmapped round body — one fit program serves the
+    whole dataset axis. With the same ``edges`` every call, this is the same
+    traced body as :func:`make_device_fit`."""
+    fit_body = _device_fit_core(cfg, budget, n_classes)
+
+    @jax.jit
+    def fit(
+        codes: jnp.ndarray,
+        edges: jnp.ndarray,
+        state: state_lib.PoolState,
+        key: jax.Array,
+    ):
+        return fit_body(codes, edges, state, key)
 
     return fit
 

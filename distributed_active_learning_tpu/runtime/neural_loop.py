@@ -182,6 +182,67 @@ def _place_on_mesh(cfg: MeshConfig, state, pool_x, net_state):
 FUSABLE_STRATEGIES = frozenset(_SCORES) | {"random", "density"}
 
 
+def _make_neural_round_core(
+    learner: NeuralLearner,
+    strat: str,
+    window_size: int,
+    beta: float,
+    with_metrics: bool,
+    n_classes: int,
+):
+    """The fit → MC-score → select → reveal → accuracy body shared by the
+    serial chunk and the seed-sweep lane (vmapped there), factored out so the
+    two entry points cannot drift — the neural twin of
+    ``runtime.loop._device_fit_core``. Returns ``(net, new_st, acc, picked,
+    metrics-or-None)``; the callers own the key split, the active/no-op cond,
+    and the ys layout."""
+
+    def round_core(st, net_in, pool_x, test_x, test_y, k_fit, k_mc, k_rand):
+        fit_mask = st.labeled_mask
+        if st.n_valid != st.n_pool:
+            fit_mask = fit_mask & st.valid_mask
+        net = learner.fit_on_mask(net_in, pool_x, st.oracle_y, fit_mask, k_fit)
+
+        unlabeled = ~st.labeled_mask
+        probs = None
+        if strat != "random" or with_metrics:
+            probs = learner.predict_proba_samples(net, pool_x, k_mc)
+        if strat == "random":
+            scores = jax.random.uniform(k_rand, (st.n_pool,))
+        elif strat == "density":
+            from distributed_active_learning_tpu.ops.similarity import (
+                similarity_mass,
+            )
+
+            ent = deep.predictive_entropy(probs)
+            emb = learner.embed(net, pool_x)
+            mass = jnp.maximum(similarity_mass(emb, unlabeled), 0.0)
+            scores = ent * jnp.power(mass, beta)
+        else:
+            scores = _SCORES[strat](probs)
+        vals, picked = select_top_k(scores, unlabeled, window_size)
+        new_st = state_lib.reveal(st, picked)
+
+        acc = jnp.mean(
+            (
+                jnp.argmax(learner.predict_proba(net, test_x), -1) == test_y
+            ).astype(jnp.float32)
+        )
+        metrics = None
+        if with_metrics:
+            from distributed_active_learning_tpu.runtime import telemetry
+
+            metrics = telemetry.selection_metrics(
+                st, picked, vals, scores,
+                higher_is_better=True,
+                n_classes=n_classes,
+                pool_entropy=deep.predictive_entropy(probs),
+            )
+        return net, new_st, acc, picked, metrics
+
+    return round_core
+
+
 def make_neural_chunk_fn(
     learner: NeuralLearner,
     strat: str,
@@ -233,6 +294,10 @@ def make_neural_chunk_fn(
         )
     from distributed_active_learning_tpu.runtime.pipeline import ChunkExtras
 
+    round_core = _make_neural_round_core(
+        learner, strat, window_size, beta, with_metrics, n_classes
+    )
+
     @jax.jit
     def chunk_fn(net_state, state, key, pool_x, init_net, test_x, test_y, end_round):
         def body(carry, _):
@@ -242,35 +307,8 @@ def make_neural_chunk_fn(
             k_next, k_fit, k_mc, k_rand = jax.random.split(k, 4)
 
             net_in = init_net if retrain_from_scratch else net_c
-            fit_mask = st.labeled_mask
-            if st.n_valid != st.n_pool:
-                fit_mask = fit_mask & st.valid_mask
-            net = learner.fit_on_mask(net_in, pool_x, st.oracle_y, fit_mask, k_fit)
-
-            unlabeled = ~st.labeled_mask
-            probs = None
-            if strat != "random" or with_metrics:
-                probs = learner.predict_proba_samples(net, pool_x, k_mc)
-            if strat == "random":
-                scores = jax.random.uniform(k_rand, (st.n_pool,))
-            elif strat == "density":
-                from distributed_active_learning_tpu.ops.similarity import (
-                    similarity_mass,
-                )
-
-                ent = deep.predictive_entropy(probs)
-                emb = learner.embed(net, pool_x)
-                mass = jnp.maximum(similarity_mass(emb, unlabeled), 0.0)
-                scores = ent * jnp.power(mass, beta)
-            else:
-                scores = _SCORES[strat](probs)
-            vals, picked = select_top_k(scores, unlabeled, window_size)
-            new_st = state_lib.reveal(st, picked)
-
-            acc = jnp.mean(
-                (
-                    jnp.argmax(learner.predict_proba(net, test_x), -1) == test_y
-                ).astype(jnp.float32)
+            net, new_st, acc, picked, rm = round_core(
+                st, net_in, pool_x, test_x, test_y, k_fit, k_mc, k_rand
             )
             out = jax.lax.cond(
                 active,
@@ -284,14 +322,6 @@ def make_neural_chunk_fn(
                 jax.debug.callback(stream_cb, st.round + 1, n_labeled, acc, active)
             ys = (st.round + 1, n_labeled, acc, picked, active)
             if with_metrics:
-                from distributed_active_learning_tpu.runtime import telemetry
-
-                rm = telemetry.selection_metrics(
-                    st, picked, vals, scores,
-                    higher_is_better=True,
-                    n_classes=n_classes,
-                    pool_entropy=deep.predictive_entropy(probs),
-                )
                 ys = ys + (rm,)
             return out, ys
 
@@ -305,6 +335,295 @@ def make_neural_chunk_fn(
         return (net_out, st_out, key_out), extras, ys
 
     return chunk_fn
+
+
+def make_neural_sweep_chunk_fn(
+    learner: NeuralLearner,
+    strat: str,
+    window_size: int,
+    chunk_size: int,
+    label_cap: int,
+    retrain_from_scratch: bool = True,
+    beta: float = 1.0,
+    with_metrics: bool = False,
+    n_classes: int = 2,
+):
+    """:func:`make_neural_chunk_fn` vmapped over a leading experiment axis E.
+
+    The ``--sweep-seeds`` discipline applied to the deep loop (the ROADMAP
+    PR-5 follow-up): the carry's ``TrainState`` batches like the labeled
+    mask — ``net_states`` / ``init_nets`` are per-seed pytrees stacked on a
+    leading ``[E]`` axis, masks ``[E, n]``, loop keys ``[E]``, round
+    counters ``[E]`` — while the pool (``pool_x`` / ``oracle_y``) and test
+    arrays stay SHARED across the batch. Each lane runs the serial chunk's
+    exact per-round body (same 4-way key split, same masked no-op freeze),
+    so per-seed records are bit-identical to E serial
+    ``run_neural_experiment`` runs; vmap is a compilation strategy, never a
+    semantic one.
+
+    Returns ``chunk_fn(net_states, masks, keys, rounds, pool_x, oracle_y,
+    init_nets, test_x, test_y, end_rounds) -> ((nets, masks, keys, rounds),
+    extras, ys)`` with every y stacked ``[chunk_size, E, ...]`` and
+    ``extras`` the batch-reduced :class:`~runtime.pipeline.ChunkExtras`
+    (MIN labeled count, MAX active rounds — the sweep stop contract). The
+    carry is NOT donated, matching the serial neural chunk.
+    """
+    if strat not in FUSABLE_STRATEGIES:
+        raise ValueError(
+            f"strategy {strat!r} cannot fuse in-scan; fusable: "
+            f"{sorted(FUSABLE_STRATEGIES)}"
+        )
+    from distributed_active_learning_tpu.runtime.pipeline import ChunkExtras
+
+    round_core = _make_neural_round_core(
+        learner, strat, window_size, beta, with_metrics, n_classes
+    )
+
+    @jax.jit
+    def chunk_fn(
+        net_states, masks, keys, rounds, pool_x, oracle_y, init_nets,
+        test_x, test_y, end_rounds,
+    ):
+        n = pool_x.shape[0]
+
+        def body(carry, _):
+            nets_c, masks_c, keys_c, rounds_c = carry
+
+            def one(net_c, mask, k, rnd, init_net, end_round):
+                # Per-lane round: the shared serial body (same key protocol,
+                # same reveal, same masked no-op freeze) over a lane-local
+                # PoolState view of the shared pool.
+                st = state_lib.PoolState(
+                    x=jnp.zeros((n, 0), jnp.float32), oracle_y=oracle_y,
+                    labeled_mask=mask, key=k, round=rnd,
+                )
+                n_labeled = state_lib.labeled_count(st)
+                active = (n_labeled < label_cap) & (rnd < end_round)
+                k_next, k_fit, k_mc, k_rand = jax.random.split(k, 4)
+
+                net_in = init_net if retrain_from_scratch else net_c
+                net, new_st, acc, picked, rm = round_core(
+                    st, net_in, pool_x, test_x, test_y, k_fit, k_mc, k_rand
+                )
+                out = jax.lax.cond(
+                    active,
+                    lambda: (net, new_st.labeled_mask, k_next, new_st.round),
+                    lambda: (net_c, mask, k, rnd),
+                )
+                ys = (rnd + 1, n_labeled, acc, picked, active)
+                if with_metrics:
+                    ys = ys + (rm,)
+                return out, ys
+
+            (nets, m, k, r), ys = jax.vmap(one)(
+                nets_c, masks_c, keys_c, rounds_c, init_nets, end_rounds
+            )
+            return (nets, m, k, r), ys
+
+        (nets_out, masks_out, keys_out, rounds_out), ys = jax.lax.scan(
+            body, (net_states, masks, keys, rounds), None, length=chunk_size
+        )
+        extras = ChunkExtras(
+            n_labeled_after=jnp.min(
+                jnp.sum(masks_out.astype(jnp.int32), axis=1)
+            ),
+            n_active=jnp.max(jnp.sum(ys[4].astype(jnp.int32), axis=0)),
+        )
+        return (nets_out, masks_out, keys_out, rounds_out), extras, ys
+
+    return chunk_fn
+
+
+def run_neural_sweep(
+    cfg: NeuralExperimentConfig,
+    learner: NeuralLearner,
+    train_x,
+    train_y,
+    test_x,
+    test_y,
+    seeds,
+    debugger: Optional[Debugger] = None,
+    data_ident: Optional[dict] = None,
+    metrics=None,
+):
+    """Run E = len(seeds) deep-AL experiments over one shared pool as a
+    single batched launch stream; returns one :class:`ExperimentResult` per
+    seed (the neural twin of ``runtime.sweep.run_sweep``).
+
+    Per-seed records are bit-identical to E serial
+    :func:`run_neural_experiment` runs with ``seed=s`` substituted: every
+    per-seed key (pool state, loop key, network init) derives exactly as the
+    serial driver derives it, and the vmapped chunk runs the serial round
+    body per lane. Falls back to E serial runs for strategies outside
+    :data:`FUSABLE_STRATEGIES` and for per-phase debugging. Mesh sharding
+    and checkpointing are not supported by the batched path (a mesh config
+    falls back serially; ``checkpoint_dir`` raises — one file per seed would
+    need the grid format, a follow-up).
+    """
+    dbg = debugger or Debugger(enabled=False)
+    seeds = [int(s) for s in seeds]
+    if not seeds:
+        raise ValueError("run_neural_sweep needs at least one seed")
+    strat = _normalize_deep_name(cfg.strategy)
+    if strat not in _deep_names():
+        raise KeyError(
+            f"unknown deep strategy {cfg.strategy!r}; available: "
+            f"{available_deep_strategies()}"
+        )
+    if cfg.checkpoint_dir and cfg.checkpoint_every:
+        raise ValueError(
+            "checkpointing is not supported by the batched neural sweep; "
+            "run the seeds serially or drop --checkpoint-dir"
+        )
+
+    def _serial():
+        out = []
+        for s in seeds:
+            out.append(
+                run_neural_experiment(
+                    dataclasses.replace(cfg, seed=s), learner,
+                    train_x, train_y, test_x, test_y,
+                    debugger=debugger, data_ident=data_ident, metrics=metrics,
+                )
+            )
+        return out
+
+    sharded = cfg.mesh.data * cfg.mesh.model > 1
+    if (
+        strat not in FUSABLE_STRATEGIES
+        or getattr(dbg, "phase_detail", False)
+        or sharded
+    ):
+        return _serial()
+
+    x = jnp.asarray(train_x)
+    y = jnp.asarray(train_y)
+    test_x = jnp.asarray(test_x)
+    test_y = jnp.asarray(test_y)
+    n = x.shape[0]
+    n_classes = int(jnp.max(y)) + 1
+
+    # Per-seed state exactly as the serial driver builds it, then stacked.
+    states = []
+    for s in seeds:
+        st = state_lib.init_pool_state(
+            jnp.zeros((n, 0), jnp.float32), y, jax.random.key(s)
+        )
+        states.append(
+            state_lib.set_start_state(st, cfg.n_start, n_classes=max(n_classes, 2))
+        )
+    masks0 = jnp.stack([st.labeled_mask for st in states])
+    keys0 = jnp.stack([jax.random.key(s + 1) for s in seeds])
+    rounds0 = jnp.zeros((len(seeds),), dtype=jnp.int32)
+    init_nets = jax.tree.map(
+        lambda *ls: jnp.stack(ls),
+        *[learner.init(jax.random.key(s + 2)) for s in seeds],
+    )
+
+    if metrics is not None:
+        metrics.meta(
+            config=dataclasses.asdict(cfg),
+            loop="neural_sweep",
+            backend=jax.default_backend(),
+            n_devices=jax.device_count(),
+            process_count=jax.process_count(),
+            sweep_seeds=seeds,
+        )
+
+    from distributed_active_learning_tpu.runtime import (
+        pipeline as pipeline_lib,
+        telemetry,
+    )
+
+    E = len(seeds)
+    K = max(int(cfg.rounds_per_launch or 1), 1)
+    window = cfg.window_size
+    label_cap = n if cfg.label_budget is None else min(cfg.label_budget, n)
+    depth = max(int(getattr(cfg, "pipeline_depth", 1) or 1), 1)
+    want_metrics = metrics is not None
+    chunk_fn = make_neural_sweep_chunk_fn(
+        learner, strat, window, K, label_cap,
+        retrain_from_scratch=cfg.retrain_from_scratch,
+        beta=cfg.beta,
+        with_metrics=want_metrics,
+        n_classes=max(n_classes, 2),
+    )
+    launches = telemetry.LaunchTracker(
+        metrics, "neural_sweep_chunk_scan", fn=chunk_fn
+    )
+    end_rounds = jnp.full(
+        (E,),
+        cfg.max_rounds if cfg.max_rounds is not None else np.iinfo(np.int32).max,
+        dtype=jnp.int32,
+    )
+    counts0 = [int(c) for c in np.asarray(jnp.sum(masks0, axis=1))]
+    ctl = pipeline_lib.ChunkDriveControl(
+        K, window, label_cap, cfg.max_rounds, min(counts0), 0
+    )
+    results = [ExperimentResult() for _ in seeds]
+
+    def dispatch(carry, _idx):
+        nets, m, k, r = carry
+        return chunk_fn(
+            nets, m, k, r, x, y, init_nets, test_x, test_y, end_rounds
+        )
+
+    def touchdown(_idx, _n_labeled_after, n_active, ys, _out, wall):
+        if n_active == 0:
+            return
+        rounds_y, labeled_y, acc_y, _picked_y, active_y = ys[:5]
+        active_np = np.asarray(active_y)  # [K, E]
+        rounds_np = np.asarray(rounds_y)
+        labeled_np = np.asarray(labeled_y)
+        acc_np = np.asarray(acc_y)
+        total_active = int(active_np.sum())
+        md = (
+            telemetry.stacked_sweep_metrics_to_dicts(ys[5], active_np)
+            if want_metrics
+            else None
+        )
+        last_round = ctl.round_idx
+        for e in range(E):
+            act = active_np[:, e]
+            if not act.any():
+                continue
+            r_e = rounds_np[act, e]
+            l_e = labeled_np[act, e]
+            a_e = acc_np[act, e]
+            results[e].extend_from_arrays(
+                r_e, l_e, n - l_e, a_e,
+                total_time=wall / total_active,
+                metrics=md[e] if md is not None else None,
+            )
+            last_round = max(last_round, int(r_e[-1]))
+            if metrics is not None:
+                for i in range(len(r_e)):
+                    metrics.round(
+                        exp=e,
+                        seed=seeds[e],
+                        round=int(r_e[i]),
+                        n_labeled=int(l_e[i]),
+                        accuracy=float(a_e[i]),
+                        **(md[e][i] if md is not None else {}),
+                    )
+        ctl.note_round(last_round)
+
+    if not ctl.already_done:
+        pipeline_lib.run_pipelined(
+            (init_nets, masks0, keys0, rounds0),
+            dispatch=dispatch,
+            touchdown=touchdown,
+            continue_after=ctl.continue_after,
+            depth=depth,
+            on_launch=launches.record,
+            may_dispatch=ctl.may_dispatch,
+            on_veto=lambda idx: launches.veto(idx, ctl.veto_reason(idx)),
+        )
+    if metrics is not None:
+        mem = telemetry.device_memory_gauges()
+        if mem:
+            metrics.gauges(mem, allgather=True)
+    return results
 
 
 def run_neural_experiment(

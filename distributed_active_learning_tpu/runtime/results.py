@@ -188,6 +188,58 @@ def plot_seed_band(results, path: str, title: str = "", label: str = "sweep") ->
     return path
 
 
+def grid_curves(grid):
+    """Per-(strategy, dataset) seed-stacked accuracy curves from one grid run.
+
+    ``grid``: a :class:`~runtime.sweep.GridResult` (or anything with a
+    ``.cells`` list of objects carrying ``strategy``/``dataset``/``result``).
+    Returns ``{(strategy, dataset): (grid_axis, accs [seeds, rounds])}`` via
+    :func:`strategy_curves` — the whole paper results matrix, stacked for
+    banding, from a single launch stream. Groups whose seeds disagree on the
+    labeled-count axis raise, like :func:`strategy_curves` itself.
+    """
+    groups = {}
+    for cell in grid.cells:
+        groups.setdefault((cell.strategy, cell.dataset), []).append(cell.result)
+    return {key: strategy_curves(results) for key, results in groups.items()}
+
+
+def plot_grid_bands(grid, path: str, title: str = "") -> str:
+    """Mean +/- 1 sd accuracy bands for every (strategy, dataset) group of a
+    grid run — the paper's strategy-comparison figure (distUS vs distRAND
+    bands) produced from ONE ``run.py --strategies ... --sweep-seeds N``
+    launch instead of S x E hand-collected logs."""
+    import matplotlib
+
+    matplotlib.use("Agg")  # headless
+    import matplotlib.pyplot as plt
+
+    curves = grid_curves(grid)
+    multi_ds = len({ds for _s, ds in curves}) > 1
+    fig, ax = plt.subplots(figsize=(7.5, 4.5))
+    for (strat, ds), (grid_axis, accs) in sorted(curves.items()):
+        accs = accs * 100
+        mean, sd = accs.mean(axis=0), accs.std(axis=0)
+        label = f"{strat}/{ds}" if multi_ds else strat
+        (line,) = ax.plot(
+            grid_axis, mean, marker="o", ms=3,
+            label=f"{label} (n={accs.shape[0]})",
+        )
+        ax.fill_between(
+            grid_axis, mean - sd, mean + sd, alpha=0.2, color=line.get_color()
+        )
+    ax.set_xlabel("labeled points")
+    ax.set_ylabel("test accuracy (%)")
+    ax.grid(True, alpha=0.3)
+    ax.legend()
+    if title:
+        ax.set_title(title)
+    fig.tight_layout()
+    fig.savefig(path, dpi=120)
+    plt.close(fig)
+    return path
+
+
 def plot_result(result: ExperimentResult, path: str, title: str = "") -> str:
     """Save the experiment's curves as a PNG — the reference's per-run
     matplotlib artifact (``classes/active_learner.py:369-384`` plots

@@ -637,3 +637,874 @@ def run_sweep(
         for s, res in zip(seeds, results):
             res.save(_sweep_result_path(cfg.results_path, s), fmt="reference")
     return results
+
+
+# ---------------------------------------------------------------------------
+# The full paper grid: strategies x seeds x datasets as ONE launch stream
+# ---------------------------------------------------------------------------
+#
+# run_sweep batches the seed axis of one (strategy, dataset) cell; the grid
+# launcher below generalizes it to the reference paper's whole results matrix.
+# Three ideas on top of the sweep machinery:
+#
+# - **Heterogeneous strategies group by scoring family.** Cells are laid out
+#   strategy-major (cell c = g*D*E + d*E + e); each strategy group is then a
+#   STATIC contiguous slice of the cell axis, so the scan body runs one
+#   score + top-k program per group (its own direction and score function,
+#   zero wasted scoring work) and concatenates the group outputs back in cell
+#   order — the "masked merge" is a static concat, not a lax.switch over all
+#   branches.
+#
+# - **The dataset axis is a second vmap, not a gather.** Pool arrays stack
+#   per dataset ([D, n_pad, ...], padded to a common slab width); the round
+#   body vmaps over D OUTSIDE the seed vmap, so each dataset's pool is shared
+#   by its E seeds exactly like the sweep shares its single pool — no
+#   per-cell pool copies. Heterogeneous pool widths ride PoolState's dynamic
+#   ``n_filled`` watermark (the PR-7 slab mechanism): padding rows are
+#   labeled=True sentinels AND excluded from fit gathers / counts / metrics
+#   by the fill mask, so per-dataset statistics match unpadded serial runs.
+#
+# - **Stopping reduces to the worst remaining budget.** Cells own per-cell
+#   label caps (min(label_budget, n_valid_d) differs per dataset), so the
+#   batch-reduced stop scalar is ``-max_c(cap_c - count_c)`` — >= 0 exactly
+#   when EVERY cell reached its cap. ChunkDriveControl runs unchanged with
+#   ``label_cap=0`` and ``n_known=-max_remaining``; its veto lattice stays
+#   safe (min-window steps under-estimate every cell's progress).
+
+
+@dataclasses.dataclass
+class GridCell:
+    """One (strategy, dataset, seed) cell of a grid run."""
+
+    strategy: str
+    dataset: str
+    seed: int
+    window: int
+    result: ExperimentResult = dataclasses.field(default_factory=ExperimentResult)
+
+
+@dataclasses.dataclass
+class GridResult:
+    """All cells of one grid launch stream, in cell order (strategy-major,
+    then dataset, then seed), plus the launch accounting the acceptance
+    gates key on (``recompiles_after_warmup == 0`` after the first grid
+    launch)."""
+
+    cells: List[GridCell]
+    launches: int = 0
+    recompiles_after_warmup: int = 0
+    serial_fallback: bool = False
+
+    def cell(self, strategy: str, dataset: str, seed: int) -> GridCell:
+        for c in self.cells:
+            if (c.strategy, c.dataset, c.seed) == (strategy, dataset, int(seed)):
+                return c
+        raise KeyError(f"no grid cell ({strategy}, {dataset}, {seed})")
+
+    def results_for(self, strategy: str, dataset: Optional[str] = None):
+        """Per-seed results of one strategy (optionally one dataset) in seed
+        order — the input shape ``results.strategy_curves`` stacks."""
+        return [
+            c.result
+            for c in self.cells
+            if c.strategy == strategy and (dataset is None or c.dataset == dataset)
+        ]
+
+
+def _grid_result_path(
+    path: str, strategy: str, dataset: str, seed: int, with_dataset: bool
+) -> str:
+    """Per-cell results file: ``curve.txt`` -> ``curve_margin_s3.txt`` (plus
+    the dataset name once the grid has a dataset axis)."""
+    import os
+
+    stem, ext = os.path.splitext(path)
+    ds = f"_{dataset}" if with_dataset else ""
+    return f"{stem}_{strategy}{ds}_s{seed}{ext}"
+
+
+def _grid_counts(mask: jnp.ndarray, n_valids_cell: jnp.ndarray) -> jnp.ndarray:
+    """Per-cell real-row labeled counts for a ``[C, n]`` mask batch with
+    per-cell valid widths (padding rows are labeled=True sentinels)."""
+    valid = jnp.arange(mask.shape[1])[None, :] < n_valids_cell[:, None]
+    return jnp.sum((mask & valid).astype(jnp.int32), axis=1)
+
+
+def make_grid_chunk_fn(
+    strategies: Sequence[Strategy],
+    window_pad: int,
+    chunk_size: int,
+    fit_fn,
+    *,
+    n_datasets: int,
+    n_seeds: int,
+    static_n_valid: int = -1,
+    use_fill: bool = False,
+    use_test_fill: bool = False,
+    mesh=None,
+    wrap_pallas: bool = False,
+    with_metrics: bool = False,
+    n_classes: int = 2,
+    donate: bool = True,
+):
+    """One jitted launch advancing the whole S x D x E grid by ``chunk_size``
+    rounds.
+
+    ``strategies`` is one :class:`~strategies.Strategy` per group in cell
+    order; ``fit_fn`` is the edges-as-argument device fit
+    (``runtime.loop.make_grid_device_fit``). Cell layout is strategy-major
+    (``c = g*D*E + d*E + e``): per scan step each group runs its OWN padded
+    round program (score family, selection direction, top-k at the grid's
+    widest window) over a ``vmap(datasets) o vmap(seeds)`` nest sharing the
+    stacked pool arrays, and the group outputs concatenate back in cell
+    order. ``use_fill`` routes heterogeneous pool widths through PoolState's
+    dynamic ``n_filled`` watermark; ``use_test_fill`` masks the accuracy
+    pass to each dataset's real test rows.
+
+    Returns ``grid_chunk_fn(codes, x, oracle_y, grid, seed_masks,
+    lal_forests, fit_keys, windows, test_x, test_y, end_rounds, label_caps,
+    edges, n_valids, test_ns) -> (new_grid, extras, ys)`` with every y
+    stacked ``[chunk_size, C, ...]``. ``extras.n_labeled_after`` is
+    ``-max_c(label_cap_c - count_c)`` (>= 0 iff every cell hit its cap) and
+    ``extras.n_active`` the max active-round count — the exact scalar pair
+    ``ChunkDriveControl(label_cap=0, n_known=-max_remaining)`` drives
+    through ``run_pipelined`` unchanged.
+    """
+    from distributed_active_learning_tpu.runtime.loop import (
+        _accuracy,
+        _accuracy_masked,
+        make_padded_round_fn,
+    )
+
+    G, D, E = len(strategies), n_datasets, n_seeds
+    DE = D * E
+    C_ = G * DE
+    round_fns = [
+        make_padded_round_fn(
+            s, window_pad, with_metrics=with_metrics, n_classes=n_classes
+        )
+        for s in strategies
+    ]
+
+    @functools.partial(jax.jit, donate_argnums=(3,) if donate else ())
+    def grid_chunk_fn(
+        codes: jnp.ndarray,      # [D, n, f] per-dataset bin codes
+        x: jnp.ndarray,          # [D, n, d] stacked pools
+        oracle_y: jnp.ndarray,   # [D, n]
+        grid: SweepState,        # [C, ...] donated carry
+        seed_masks: jnp.ndarray, # [C, n]
+        lal_forests,             # tuple, one (or None) per strategy group
+        fit_keys: jax.Array,     # [C]
+        windows: jnp.ndarray,    # [C]
+        test_x: jnp.ndarray,     # [D, t, d]
+        test_y: jnp.ndarray,     # [D, t]
+        end_rounds: jnp.ndarray, # [C]
+        label_caps: jnp.ndarray, # [C]
+        edges: jnp.ndarray,      # [D, d, bins-1]
+        n_valids: jnp.ndarray,   # [D] real pool rows per dataset
+        test_ns: jnp.ndarray,    # [D] real test rows per dataset
+    ):
+        # Cell-axis <-> dataset-major reshapes for the strategy-independent
+        # passes: cells are strategy-major ([G, D, E] in cell order), but the
+        # fit and accuracy programs batch most cheaply with the dataset axis
+        # leading ([D, G*E]) so ONE program instance serves every group —
+        # the strategy loop below then pays only its score/select body.
+        def to_dm(leaf):
+            l = leaf.reshape((G, D, E) + leaf.shape[1:])
+            return jnp.moveaxis(l, 1, 0).reshape((D, G * E) + leaf.shape[1:])
+
+        def from_dm(leaf):
+            l = leaf.reshape((D, G, E) + leaf.shape[2:])
+            return jnp.moveaxis(l, 0, 1).reshape((C_,) + leaf.shape[2:])
+
+        def body(carry: SweepState, _):
+            def fit_one(x_d, oy_d, codes_d, edges_d, nv_d, mask, key, rnd,
+                        fit_key):
+                # The cell's PoolState view over its dataset's shared
+                # (stacked) pool arrays — same pytree the serial fit
+                # consumes; heterogeneous widths ride n_filled.
+                state = state_lib.PoolState(
+                    x=x_d, oracle_y=oy_d, labeled_mask=mask, key=key,
+                    round=rnd, n_valid_static=static_n_valid,
+                    n_filled=nv_d if use_fill else None,
+                )
+                forest = fit_fn(
+                    codes_d, edges_d, state,
+                    jax.random.fold_in(fit_key, rnd + 1),
+                )
+                if mesh is not None:
+                    from distributed_active_learning_tpu.parallel import (
+                        constrain_forest,
+                    )
+
+                    forest = constrain_forest(forest, mesh)
+                    if wrap_pallas:
+                        from distributed_active_learning_tpu.ops.trees_pallas import (  # noqa: E501
+                            attach_mesh,
+                        )
+
+                        forest = attach_mesh(forest, mesh)
+                return forest
+
+            def acc_one(tx_d, ty_d, tn_d, forest):
+                if use_test_fill:
+                    return _accuracy_masked(forest, tx_d, ty_d, tn_d)
+                return _accuracy(forest, tx_d, ty_d)
+
+            if D == 1:
+                # Single-dataset grids (the headline S x E shape) drop the
+                # dataset vmap entirely: pool args are static [0] slices
+                # shared by one cell-axis vmap — the sweep's exact batching
+                # shape, and a materially smaller compile than the nested
+                # form.
+                forests = jax.vmap(
+                    functools.partial(
+                        fit_one, x[0], oracle_y[0], codes[0], edges[0],
+                        n_valids[0],
+                    )
+                )(carry.labeled_mask, carry.key, carry.round, fit_keys)
+                accs = jax.vmap(
+                    functools.partial(acc_one, test_x[0], test_y[0], test_ns[0])
+                )(forests)
+            else:
+                forests = jax.vmap(
+                    jax.vmap(fit_one, in_axes=(None,) * 5 + (0,) * 4),
+                    in_axes=(0,) * 9,
+                )(
+                    x, oracle_y, codes, edges, n_valids,
+                    to_dm(carry.labeled_mask), to_dm(carry.key),
+                    to_dm(carry.round), to_dm(fit_keys),
+                )
+                accs = jax.vmap(
+                    jax.vmap(acc_one, in_axes=(None,) * 3 + (0,)),
+                    in_axes=(0,) * 4,
+                )(test_x, test_y, test_ns, forests)
+                forests = jax.tree.map(from_dm, forests)
+                accs = from_dm(accs)
+
+            group_states, group_ys = [], []
+            for g in range(G):
+                sl = slice(g * DE, (g + 1) * DE)
+                round_fn = round_fns[g]
+                lal_forest = lal_forests[g]
+
+                def one(
+                    x_d, oy_d, nv_d, forest, acc, mask, key, rnd, seed_mask,
+                    window, end_round, cap,
+                    _round_fn=round_fn, _lal=lal_forest,
+                ):
+                    state = state_lib.PoolState(
+                        x=x_d, oracle_y=oy_d, labeled_mask=mask, key=key,
+                        round=rnd, n_valid_static=static_n_valid,
+                        n_filled=nv_d if use_fill else None,
+                    )
+                    aux = StrategyAux(lal_forest=_lal, seed_mask=seed_mask)
+                    n_labeled = state_lib.labeled_count(state)
+                    active = (n_labeled < cap) & (rnd < end_round)
+                    if with_metrics:
+                        new_state, picked, _, rm = _round_fn(
+                            forest, state, aux, window
+                        )
+                    else:
+                        new_state, picked, _ = _round_fn(forest, state, aux, window)
+                    out = state_lib.select_state(active, new_state, state)
+                    ys = (rnd + 1, n_labeled, acc, picked, active)
+                    if with_metrics:
+                        ys = ys + (rm,)
+                    return (out.labeled_mask, out.key, out.round), ys
+
+                if D == 1:
+                    g_forest = jax.tree.map(lambda l: l[sl], forests)
+                    per_cell = jax.vmap(
+                        functools.partial(
+                            one, x[0], oracle_y[0], n_valids[0],
+                        )
+                    )
+                    (m, k, r), ys = per_cell(
+                        g_forest, accs[sl], carry.labeled_mask[sl],
+                        carry.key[sl], carry.round[sl], seed_masks[sl],
+                        windows[sl], end_rounds[sl], label_caps[sl],
+                    )
+                    group_states.append((m, k, r))
+                    group_ys.append(ys)
+                    continue
+
+                def cell(leaf):
+                    # group slice of a [C, ...] cell-axis leaf -> [D, E, ...]
+                    part = leaf[sl]
+                    return part.reshape((D, E) + part.shape[1:])
+
+                # inner vmap: seeds share their dataset's pool (broadcast);
+                # outer vmap: the dataset axis batches the stacked pools.
+                per_cell = jax.vmap(
+                    jax.vmap(one, in_axes=(None,) * 3 + (0,) * 9),
+                    in_axes=(0,) * 12,
+                )
+                (m, k, r), ys = per_cell(
+                    x, oracle_y, n_valids,
+                    jax.tree.map(cell, forests), cell(accs),
+                    cell(carry.labeled_mask), cell(carry.key),
+                    cell(carry.round), cell(seed_masks),
+                    cell(windows), cell(end_rounds), cell(label_caps),
+                )
+
+                def flat(leaf):
+                    return leaf.reshape((DE,) + leaf.shape[2:])
+
+                group_states.append((flat(m), flat(k), flat(r)))
+                group_ys.append(jax.tree.map(flat, ys))
+            merge = lambda *ls: jnp.concatenate(ls, axis=0)  # noqa: E731
+            m = merge(*(s[0] for s in group_states))
+            k = merge(*(s[1] for s in group_states))
+            r = merge(*(s[2] for s in group_states))
+            ys = jax.tree.map(merge, *group_ys)
+            return SweepState(labeled_mask=m, key=k, round=r), ys
+
+        out_grid, ys = jax.lax.scan(body, grid, None, length=chunk_size)
+        from distributed_active_learning_tpu.runtime.pipeline import ChunkExtras
+
+        n_valids_cell = jnp.tile(jnp.repeat(n_valids, E), G)
+        counts = _grid_counts(out_grid.labeled_mask, n_valids_cell)
+        remaining = label_caps - counts
+        active_per_cell = jnp.sum(ys[4].astype(jnp.int32), axis=0)  # [C]
+        extras = ChunkExtras(
+            # -max remaining budget: >= 0 means EVERY cell hit its own cap;
+            # max active counts the laggard cell — the pair ChunkDriveControl
+            # consumes with label_cap=0.
+            n_labeled_after=-jnp.max(remaining),
+            n_active=jnp.max(active_per_cell),
+        )
+        return out_grid, extras, ys
+
+    return grid_chunk_fn
+
+
+def run_grid(
+    cfg: ExperimentConfig,
+    strategies: Sequence[str],
+    seeds: Sequence[int],
+    datasets: Optional[Sequence[str]] = None,
+    windows: Optional[Sequence[int]] = None,
+    bundles=None,
+    debugger=None,
+    metrics=None,
+) -> GridResult:
+    """Run the full strategies x seeds x datasets grid as ONE pipelined
+    launch stream; returns a :class:`GridResult` with one
+    :class:`ExperimentResult` per cell.
+
+    Per-cell records are bit-identical to running
+    ``runtime.loop.run_experiment`` once per cell (strategy + dataset + seed
+    substituted into ``cfg``) PROVIDED the fit budget is pinned
+    (``ForestConfig.fit_budget`` — the bootstrap draw depends on the fit
+    window's static size, exactly the :func:`run_sweep` caveat) and, for
+    grids whose datasets differ in pool size, the strategy draws no
+    per-row randomness (``random``'s uniform vector is shaped by the padded
+    slab, so unequal-width grids reproduce it only distribution-wise).
+
+    ``windows`` is per STRATEGY (one reveal width per strategy group,
+    default ``cfg.strategy.window_size`` everywhere); selection runs at the
+    grid's widest window and reveals mask down, the sweep discipline.
+    ``bundles`` (optional) maps dataset name -> :class:`DataBundle` to skip
+    registry loads (bench mode). Falls back to the serial S x E x D loop for
+    configurations the batched chunk cannot express (host fit, per-phase
+    debugging, datasets disagreeing on feature width or class count).
+    Checkpoints write ONE ``gridstate_<round>.npz`` covering every cell
+    (``checkpoint.save_grid`` / ``grid_fingerprint`` — the sweep format
+    extended with the strategy/dataset axes).
+    """
+    from distributed_active_learning_tpu.data.datasets import get_dataset
+    from distributed_active_learning_tpu.runtime import (
+        pipeline as pipeline_lib,
+        telemetry,
+    )
+    from distributed_active_learning_tpu.runtime.debugger import Debugger
+    from distributed_active_learning_tpu.runtime.loop import (
+        ckpt_snapshot,
+        make_grid_device_fit,
+        run_experiment,
+    )
+
+    strategies = [str(s) for s in strategies]
+    seeds = [int(s) for s in seeds]
+    datasets = (
+        [cfg.data.name] if datasets is None else [str(d) for d in datasets]
+    )
+    S, E, D = len(strategies), len(seeds), len(datasets)
+    if S == 0 or E == 0 or D == 0:
+        raise ValueError("run_grid needs at least one strategy, seed, and dataset")
+    if windows is None:
+        windows = [int(cfg.strategy.window_size)] * S
+    else:
+        windows = [int(w) for w in windows]
+    if len(windows) != S:
+        raise ValueError(f"{len(windows)} windows for {S} strategies")
+    window_pad = max(windows)
+    dbg = debugger or Debugger(enabled=False)
+
+    def _cell_cfg(strat, ds, seed, window):
+        import os
+
+        return dataclasses.replace(
+            cfg,
+            seed=seed,
+            data=dataclasses.replace(cfg.data, name=ds),
+            strategy=dataclasses.replace(
+                cfg.strategy, name=strat, window_size=window
+            ),
+            results_path=(
+                _grid_result_path(cfg.results_path, strat, ds, seed, D > 1)
+                if cfg.results_path else None
+            ),
+            checkpoint_dir=(
+                os.path.join(cfg.checkpoint_dir, f"{strat}_{ds}_seed_{seed}")
+                if cfg.checkpoint_dir else None
+            ),
+        )
+
+    def _cells():
+        return [
+            GridCell(strategy=s, dataset=d, seed=e, window=w)
+            for s, w in zip(strategies, windows)
+            for d in datasets
+            for e in seeds
+        ]
+
+    _bundle_cache = {}
+
+    def _bundle(name):
+        # memoized per dataset: the serial fallback asks once per CELL, and a
+        # file-backed dataset would otherwise be re-read S*E times
+        if bundles is not None and name in bundles:
+            return bundles[name]
+        if name not in _bundle_cache:
+            _bundle_cache[name] = get_dataset(
+                dataclasses.replace(cfg.data, name=name)
+            )
+        return _bundle_cache[name]
+
+    def _serial_fallback(reason):
+        dbg.debug(f"grid launcher falling back to serial cells: {reason}")
+        cells = _cells()
+        for c in cells:
+            c.result = run_experiment(
+                _cell_cfg(c.strategy, c.dataset, c.seed, c.window),
+                bundle=_bundle(c.dataset),
+                debugger=debugger,
+                metrics=metrics,
+            )
+        return GridResult(cells=cells, serial_fallback=True)
+
+    if cfg.forest.fit != "device" or getattr(dbg, "phase_detail", False):
+        return _serial_fallback("host fit / phase-detail debugging")
+    if cfg.stream_round_events:
+        raise ValueError(
+            "stream_round_events is not supported by the batched grid chunk; "
+            "per-round events still arrive at every touchdown via the "
+            "MetricsWriter, or run the cells serially"
+        )
+
+    ds_bundles = [_bundle(d) for d in datasets]
+    feat_widths = {b.train_x.shape[-1] for b in ds_bundles}
+    if len(feat_widths) > 1 or any(
+        np.asarray(b.train_x).ndim != 2 for b in ds_bundles
+    ):
+        return _serial_fallback("datasets disagree on feature width")
+    n_classes_per = [
+        max(int(np.asarray(b.train_y).max()) + 1, 2) if np.asarray(b.train_y).size
+        else 2
+        for b in ds_bundles
+    ]
+    if len(set(n_classes_per)) > 1:
+        return _serial_fallback("datasets disagree on class count")
+    n_classes = n_classes_per[0]
+    want_metrics = metrics is not None or cfg.collect_metrics
+
+    mesh = None
+    mesh_lib = None
+    mesh_mult = 1
+    if cfg.mesh.data * cfg.mesh.model > 1:
+        from distributed_active_learning_tpu.parallel import (
+            make_mesh,
+            mesh as mesh_lib,
+        )
+
+        if cfg.forest.n_trees % cfg.mesh.model:
+            raise ValueError(
+                f"n_trees={cfg.forest.n_trees} not divisible by mesh "
+                f"model axis {cfg.mesh.model}"
+            )
+        mesh = make_mesh(data=cfg.mesh.data, model=cfg.mesh.model)
+        mesh_mult = cfg.mesh.data
+
+    # --- pad every dataset to one common slab width -------------------------
+    n_valids_host = [int(np.asarray(b.train_y).shape[0]) for b in ds_bundles]
+    n_store = max(n_valids_host)          # checkpoint mask width (no mesh pad)
+    n_slab = n_store + ((-n_store) % mesh_mult)
+    test_ns_host = [int(np.asarray(b.test_y).shape[0]) for b in ds_bundles]
+    t_slab = max(test_ns_host)
+    # Equal-width grids keep the sweep's static-n_valid path (bit-identical
+    # serial programs); only genuinely heterogeneous widths pay the dynamic
+    # fill watermark.
+    uniform_n = len(set(n_valids_host)) == 1
+    use_fill = not uniform_n
+    static_n_valid = (
+        -1 if (uniform_n and n_slab == n_store) else (n_valids_host[0] if uniform_n else -1)
+    )
+    use_test_fill = len(set(test_ns_host)) > 1
+
+    from distributed_active_learning_tpu.ops import trees_train
+
+    xs, oys, codes_list, edges_list, tests_x, tests_y = [], [], [], [], [], []
+    states_per_ds = []  # [D][E] start states over the unpadded pools
+    for b in ds_bundles:
+        host_x = np.ascontiguousarray(b.train_x, dtype=np.float32)
+        host_y = np.asarray(b.train_y, dtype=np.int32)
+        n_d = host_x.shape[0]
+        # Exactly run_experiment's init -> set_start_state per (dataset,
+        # seed), on the UNPADDED pool (the start draw is shaped by the real
+        # pool), then padded below with labeled=True sentinel rows.
+        base = state_lib.init_pool_state(host_x, host_y, jax.random.key(seeds[0]))
+        states_per_ds.append([
+            state_lib.set_start_state(
+                base.replace(key=jax.random.key(s)), cfg.n_start,
+                n_classes=n_classes,
+            )
+            for s in seeds
+        ])
+        binned = trees_train.make_bins(jnp.asarray(host_x), cfg.forest.max_bins)
+        pad = n_slab - n_d
+        xs.append(np.pad(host_x, ((0, pad), (0, 0))))
+        oys.append(np.pad(host_y, (0, pad)))
+        codes_list.append(
+            np.pad(np.asarray(binned.codes), ((0, pad), (0, 0)))
+        )
+        edges_list.append(np.asarray(binned.edges))
+        t_pad = t_slab - test_ns_host[len(tests_x)]
+        tests_x.append(
+            np.pad(np.asarray(b.test_x, dtype=np.float32), ((0, t_pad), (0, 0)))
+        )
+        tests_y.append(np.pad(np.asarray(b.test_y, dtype=np.int32), (0, t_pad)))
+
+    x = jnp.asarray(np.stack(xs))
+    oracle_y = jnp.asarray(np.stack(oys))
+    codes = jnp.asarray(np.stack(codes_list))
+    edges = jnp.asarray(np.stack(edges_list))
+    test_x = jnp.asarray(np.stack(tests_x))
+    test_y = jnp.asarray(np.stack(tests_y))
+    n_valids = jnp.asarray(n_valids_host, dtype=jnp.int32)
+    test_ns = jnp.asarray(test_ns_host, dtype=jnp.int32)
+
+    # --- per-cell vectors in cell order (strategy-major, dataset, seed) -----
+    C = S * D * E
+
+    def _pad_mask(mask_np, n_d):
+        return np.pad(
+            mask_np, (0, n_slab - n_d), constant_values=True
+        )
+
+    masks0 = np.stack([
+        _pad_mask(np.asarray(states_per_ds[d][e].labeled_mask), n_valids_host[d])
+        for _g in range(S)
+        for d in range(D)
+        for e in range(E)
+    ])
+    masks0 = jnp.asarray(masks0)
+    seed_masks = jnp.array(masks0, copy=True)
+    keys0 = jnp.stack([
+        states_per_ds[d][e].key
+        for _g in range(S)
+        for d in range(D)
+        for e in range(E)
+    ])
+    # Only the start masks and keys outlive this point; the start states hold
+    # D device copies of the UNPADDED pools (the stacked slab above is the one
+    # the grid reads), so drop them rather than hold ~2x pool HBM all run.
+    del states_per_ds
+    rounds0 = jnp.zeros((C,), dtype=jnp.int32)
+    fit_keys = jnp.stack([
+        jax.random.key(seeds[e] + 0x5EED)
+        for _g in range(S)
+        for _d in range(D)
+        for e in range(E)
+    ])
+    windows_cell = jnp.asarray(
+        [w for w in windows for _ in range(D * E)], dtype=jnp.int32
+    )
+    caps_host = [
+        n_valids_host[d] if cfg.label_budget is None
+        else min(cfg.label_budget, n_valids_host[d])
+        for _g in range(S)
+        for d in range(D)
+        for _e in range(E)
+    ]
+    label_caps = jnp.asarray(caps_host, dtype=jnp.int32)
+
+    strat_objs = []
+    lal_forests = []
+    for s, w in zip(strategies, windows):
+        scfg = dataclasses.replace(cfg.strategy, name=s, window_size=w)
+        strat_objs.append(get_strategy(scfg))
+        if s == "lal":
+            from distributed_active_learning_tpu.models.lal_training import (
+                load_or_train_lal_regressor,
+            )
+
+            lal_forests.append(load_or_train_lal_regressor(dict(scfg.options)))
+        else:
+            lal_forests.append(None)
+    lal_forests = tuple(lal_forests)
+
+    if metrics is not None:
+        from distributed_active_learning_tpu.config import asdict as cfg_asdict
+
+        metrics.meta(
+            config=cfg_asdict(cfg),
+            backend=jax.default_backend(),
+            n_devices=jax.device_count(),
+            process_count=jax.process_count(),
+            grid_strategies=strategies,
+            grid_seeds=seeds,
+            grid_datasets=datasets,
+            grid_windows=windows,
+        )
+
+    cells = _cells()
+    start_rounds = [0] * C
+
+    ckpt_enabled = bool(cfg.checkpoint_dir and cfg.checkpoint_every)
+    ckpt_fp = None
+    key_impl = jax.random.key_impl(keys0)
+    if ckpt_enabled:
+        from distributed_active_learning_tpu.runtime import checkpoint as ckpt_lib
+
+        ckpt_fp = ckpt_lib.grid_fingerprint(
+            cfg, strategies, seeds, datasets, windows
+        )
+        restored = ckpt_lib.restore_latest_grid(
+            cfg.checkpoint_dir, n_store=n_store, n_cells=C, fingerprint=ckpt_fp
+        )
+        if restored is not None:
+            r_masks, r_keys, r_rounds, r_results = restored
+            pad = n_slab - r_masks.shape[1]
+            if pad:
+                r_masks = np.pad(r_masks, ((0, 0), (0, pad)), constant_values=True)
+            masks0 = jnp.asarray(r_masks)
+            keys0 = jax.random.wrap_key_data(jnp.asarray(r_keys), impl=key_impl)
+            rounds0 = jnp.asarray(r_rounds, dtype=jnp.int32)
+            start_rounds = [int(r) for r in np.asarray(r_rounds)]
+            for c, res in zip(cells, r_results):
+                c.result = res
+            dbg.debug(f"resumed grid at rounds {start_rounds}")
+
+    counts0 = [
+        int(c) for c in np.asarray(
+            _grid_counts(
+                masks0, jnp.asarray(
+                    [n_valids_host[d] for _g in range(S) for d in range(D)
+                     for _e in range(E)],
+                    dtype=jnp.int32,
+                )
+            )
+        )
+    ]
+    fit_budget = _resolve_sweep_fit_budget(
+        cfg, max(n_valids_host), max(counts0), window_pad
+    )
+    grid_fit = make_grid_device_fit(cfg, fit_budget, n_classes)
+
+    end_rounds = jnp.asarray(
+        [
+            (sr + cfg.max_rounds) if cfg.max_rounds is not None
+            else int(np.iinfo(np.int32).max)
+            for sr in start_rounds
+        ],
+        dtype=jnp.int32,
+    )
+
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        row = NamedSharding(mesh, P(None, mesh_lib.AXIS_DATA))
+        row2 = NamedSharding(mesh, P(None, mesh_lib.AXIS_DATA, None))
+        rep = NamedSharding(mesh, P())
+        x = jax.device_put(x, row2)
+        codes = jax.device_put(codes, row2)
+        oracle_y = jax.device_put(oracle_y, row)
+        masks0 = jax.device_put(masks0, row)
+        seed_masks = jax.device_put(seed_masks, row)
+        test_x = jax.device_put(test_x, rep)
+        test_y = jax.device_put(test_y, rep)
+        edges = jax.device_put(edges, rep)
+        keys0 = mesh_lib.global_put(keys0, mesh, mesh_lib.replicated_spec())
+        fit_keys = mesh_lib.global_put(fit_keys, mesh, mesh_lib.replicated_spec())
+        rounds0 = jax.device_put(rounds0, rep)
+        windows_cell = jax.device_put(windows_cell, rep)
+        end_rounds = jax.device_put(end_rounds, rep)
+        label_caps = jax.device_put(label_caps, rep)
+        n_valids = jax.device_put(n_valids, rep)
+        test_ns = jax.device_put(test_ns, rep)
+
+    K = max(int(cfg.rounds_per_launch or 1), 1)
+    depth = max(int(getattr(cfg, "pipeline_depth", 1) or 1), 1)
+    grid_chunk = make_grid_chunk_fn(
+        strat_objs, window_pad, K, grid_fit,
+        n_datasets=D,
+        n_seeds=E,
+        static_n_valid=static_n_valid,
+        use_fill=use_fill,
+        use_test_fill=use_test_fill,
+        mesh=mesh,
+        wrap_pallas=(mesh is not None and cfg.forest.kernel == "pallas"),
+        with_metrics=want_metrics,
+        n_classes=n_classes,
+    )
+    launches = telemetry.LaunchTracker(metrics, "grid_chunk_scan", fn=grid_chunk)
+
+    # Host stop/veto arithmetic: the negative-remaining transform lets the
+    # shared ChunkDriveControl drive per-cell caps — n_known = -max remaining
+    # budget, label_cap = 0, so "all cells done" is the existing >= test; the
+    # min-window veto lattice under-estimates every cell's progress, hence
+    # stays safe (see make_grid_chunk_fn docstring).
+    rem0 = max(cap - c0 for cap, c0 in zip(caps_host, counts0))
+    ctl = pipeline_lib.ChunkDriveControl(
+        K, min(windows), 0, cfg.max_rounds, -rem0, max(start_rounds),
+    )
+
+    if not ctl.already_done:
+        worst = 0
+        for c0, cap, w in zip(
+            counts0, caps_host,
+            [w for w in windows for _ in range(D * E)],
+        ):
+            j_cap = -(-(cap - c0) // w) - 1
+            if cfg.max_rounds is not None:
+                j_cap = min(cfg.max_rounds - 1, j_cap)
+            worst = max(worst, c0 + max(j_cap, 0) * w)
+        if worst > fit_budget:
+            raise ValueError(
+                f"up to {worst} labeled rows would exceed the device fit "
+                f"window ({fit_budget}); raise ForestConfig.fit_budget or "
+                "lower label_budget/max_rounds"
+            )
+
+    grid_state = SweepState(labeled_mask=masks0, key=keys0, round=rounds0)
+    snapshots = pipeline_lib.CarrySnapshots(ckpt_snapshot)
+
+    def dispatch(gs, idx):
+        out = grid_chunk(
+            codes, x, oracle_y, gs, seed_masks, lal_forests, fit_keys,
+            windows_cell, test_x, test_y, end_rounds, label_caps, edges,
+            n_valids, test_ns,
+        )
+        if ckpt_enabled:
+            new_grid = out[0]
+            snapshots.take(
+                idx, new_grid.labeled_mask, new_grid.key, new_grid.round
+            )
+        return out
+
+    def touchdown(idx, _n_labeled_after, n_active, ys, _out, wall):
+        snap = snapshots.pop(idx)
+        if n_active == 0:
+            return
+        rounds_y, labeled_y, acc_y, _picked_y, active_y = ys[:5]
+        active_np = np.asarray(active_y)  # [K, C]
+        rounds_np = np.asarray(rounds_y)
+        labeled_np = np.asarray(labeled_y)
+        acc_np = np.asarray(acc_y)
+        total_active = int(active_np.sum())
+        md = (
+            telemetry.stacked_sweep_metrics_to_dicts(ys[5], active_np)
+            if want_metrics
+            else None
+        )
+        last_round = ctl.round_idx
+        for c in range(C):
+            act = active_np[:, c]
+            if not act.any():
+                continue
+            cell = cells[c]
+            r_c = rounds_np[act, c]
+            l_c = labeled_np[act, c]
+            a_c = acc_np[act, c]
+            n_pool_c = n_valids_host[(c // E) % D]
+            cell.result.extend_from_arrays(
+                r_c, l_c, n_pool_c - l_c, a_c,
+                total_time=wall / total_active,
+                metrics=md[c] if md is not None else None,
+            )
+            last_round = max(last_round, int(r_c[-1]))
+            if metrics is not None:
+                for i in range(len(r_c)):
+                    metrics.round(
+                        exp=c,
+                        strategy=cell.strategy,
+                        dataset=cell.dataset,
+                        seed=cell.seed,
+                        round=int(r_c[i]),
+                        n_labeled=int(l_c[i]),
+                        accuracy=float(a_c[i]),
+                        **(md[c][i] if md is not None else {}),
+                    )
+            if cfg.log_every and dbg.enabled:
+                for r, nl, a in zip(r_c, l_c, a_c):
+                    if int(r) % cfg.log_every == 0:
+                        dbg.debug(
+                            f"[{cell.strategy}/{cell.dataset}/seed "
+                            f"{cell.seed}] Iteration {int(r)} -- "
+                            f"labeled={int(nl)} accu={float(a) * 100:.2f}"
+                        )
+        ctl.note_round(last_round)
+        if metrics is not None:
+            fetched = (
+                active_y.nbytes + rounds_y.nbytes + labeled_y.nbytes
+                + acc_y.nbytes
+            )
+            if want_metrics:
+                fetched += telemetry.metrics_nbytes(ys[5])
+            metrics.counter("host_transfer_bytes", int(fetched))
+            mem = telemetry.device_memory_gauges()
+            if mem:
+                metrics.gauges(mem, allgather=True)
+        if ckpt_enabled and ctl.checkpoint_due(cfg.checkpoint_every):
+            from distributed_active_learning_tpu.runtime import (
+                checkpoint as ckpt_lib,
+            )
+
+            s_masks, s_kd, s_rounds = snap
+            ckpt_lib.save_grid(
+                cfg.checkpoint_dir, s_masks, s_kd, s_rounds,
+                [c.result for c in cells],
+                n_store=n_store, fingerprint=ckpt_fp,
+            )
+            ctl.checkpoint_done()
+
+    if not ctl.already_done:
+        pipeline_lib.run_pipelined(
+            grid_state,
+            dispatch=dispatch,
+            touchdown=touchdown,
+            continue_after=ctl.continue_after,
+            depth=depth,
+            on_launch=launches.record,
+            may_dispatch=ctl.may_dispatch,
+            on_veto=lambda idx: launches.veto(idx, ctl.veto_reason(idx)),
+        )
+
+    if cfg.results_path:
+        for c in cells:
+            c.result.save(
+                _grid_result_path(
+                    cfg.results_path, c.strategy, c.dataset, c.seed, D > 1
+                ),
+                fmt="reference",
+            )
+    cache = telemetry.jit_cache_size(grid_chunk)
+    return GridResult(
+        cells=cells,
+        launches=launches.calls,
+        recompiles_after_warmup=(
+            max(int(cache) - 1, 0) if cache is not None and launches.calls else 0
+        ),
+    )

@@ -396,6 +396,7 @@ def test_lint_real_driver_surfaces_are_clean():
         ("chunk", "uncertainty", "cpu"),
         ("sweep", "entropy", "cpu"),
         ("neural_chunk", "bald", "cpu"),
+        ("neural_sweep", "entropy", "cpu"),
     ],
 )
 def test_representative_programs_audit_clean(kind, strategy, placement):
@@ -418,10 +419,10 @@ def test_mesh_chunk_audits_clean(devices):
     assert report.findings == [], [str(f) for f in report.findings]
 
 
-@pytest.mark.slow  # the full matrix (~39 traced programs, ~40s) runs in CI
+@pytest.mark.slow  # the full matrix (~51 traced programs, ~50s) runs in CI
 def test_full_registry_audits_clean():
     report = run_audit(build_registry())
-    assert len(report.programs) >= 30
+    assert len(report.programs) >= 45
     assert report.findings == [], [str(f) for f in report.findings]
 
 
@@ -438,6 +439,22 @@ def test_registry_covers_every_strategy_and_kind():
                 assert f"{kind}/{strat}/{placement}" in names
     for strat in FUSABLE_STRATEGIES:
         assert f"neural_chunk/{strat}/cpu" in names
+        assert f"neural_sweep/{strat}/cpu" in names
+    # the PR-9 grid launcher: one heterogeneous-group program per placement
+    for placement in ("cpu", "mesh4x2"):
+        assert f"grid/uncertainty+margin+density/{placement}" in names
+
+
+@pytest.mark.slow  # one heavy trace; the CI analysis job audits it per-PR
+def test_grid_program_audits_clean():
+    """The heterogeneous-grid chunk (3 strategy groups x 2 datasets x 2
+    seeds, dynamic fill watermark + masked accuracy) traces and passes every
+    invariant rule — the standing gate for the grid fast path."""
+    specs = build_registry(kinds=["grid"], placements=["cpu"])
+    assert len(specs) == 1
+    report = run_audit(specs)
+    assert report.programs == ["grid/uncertainty+margin+density/cpu"]
+    assert report.findings == [], [str(f) for f in report.findings]
 
 
 def test_specs_for_experiment_audits_the_configured_mesh_shape(devices):
@@ -476,6 +493,30 @@ def test_specs_for_experiment_audits_the_configured_mesh_shape(devices):
     assert [s.name for s in specs_for_experiment(swept)] == [
         "sweep/uncertainty/mesh2x1"
     ]
+
+
+def test_specs_for_experiment_neural_sweep_and_grid_group_spelling():
+    """--neural --sweep-seeds launches the batched neural_sweep program, so
+    that is what --audit must trace (not the serial chunk); and a custom
+    --strategies group keeps its EXACT spelling — the registry's grid kind
+    only carries the fixed uncertainty+margin+density stand-in."""
+    from distributed_active_learning_tpu.analysis import specs_for_experiment
+    from distributed_active_learning_tpu.config import ExperimentConfig
+
+    assert [
+        s.kind for s in specs_for_experiment(None, neural_strategy="entropy")
+    ] == ["neural_chunk"]
+    assert [
+        s.kind
+        for s in specs_for_experiment(
+            None, neural_strategy="entropy", neural_sweep=True
+        )
+    ] == ["neural_sweep"]
+
+    specs = specs_for_experiment(
+        ExperimentConfig(), grid_strategies=["uncertainty", "margin"]
+    )
+    assert [s.name for s in specs] == ["grid/uncertainty+margin/cpu"]
 
 
 def test_mesh_programs_skip_cleanly_without_devices(monkeypatch):

@@ -105,7 +105,7 @@ def test_mode_all_deadline_skips_are_structured(bench):
     assert out["metric"] == "none_completed_before_deadline"
     skips = out["modes_skipped"]
     assert [s["mode"] for s in skips] == [
-        "score", "density", "round", "sweep", "serve", "lal", "neural",
+        "score", "density", "round", "sweep", "grid", "serve", "lal", "neural",
     ]
     for s in skips:
         assert s["reason"] == "deadline_exceeded"
@@ -296,3 +296,65 @@ def test_bench_sweep_contract(bench):
     assert r["sweep_experiments_rounds_per_second"] > 0
     assert r["serial_experiments_rounds_per_second"] > 0
     assert r["sweep_speedup"] > 0
+
+
+def test_baseline_leg_gating(bench):
+    """The serial-baseline leg's skip logic: --no-baseline skips outright,
+    a deadline with insufficient remaining budget auto-skips (with a reason
+    record), plenty of budget runs it."""
+    import time
+
+    a = argparse.Namespace(no_baseline=True)
+    run, skip = bench._baseline_leg_ok(a, est_seconds=1.0)
+    assert not run and skip == {"reason": "no_baseline_flag"}
+
+    a = argparse.Namespace(
+        no_baseline=False, deadline=10.0, _start_time=time.perf_counter() - 9.0
+    )
+    run, skip = bench._baseline_leg_ok(a, est_seconds=100.0)
+    assert not run and skip["reason"] == "deadline"
+    assert skip["estimated_baseline_seconds"] == 100.0
+
+    a = argparse.Namespace(
+        no_baseline=False, deadline=1000.0, _start_time=time.perf_counter()
+    )
+    run, skip = bench._baseline_leg_ok(a, est_seconds=1.0)
+    assert run and skip is None
+
+
+def test_bench_sweep_no_baseline_records_skip(bench):
+    """--no-baseline: the batched leg's metrics land, the serial keys are
+    absent, and baseline_skipped explains why."""
+    r = bench.bench_sweep(_args(
+        sweep_experiments=2, sweep_pool=120, rounds_per_launch=2, window=10,
+        no_baseline=True,
+    ))
+    assert r["sweep_experiments_rounds_per_second"] > 0
+    assert "sweep_speedup" not in r
+    assert r["baseline_skipped"] == {"reason": "no_baseline_flag"}
+
+
+def test_bench_grid_contract_no_baseline(bench):
+    """Grid mode (tiny shapes, baseline skipped): the one-launch-stream
+    metrics land with the recompile contract intact; the full grid-vs-serial
+    comparison runs in the CI smoke job and the slow variant."""
+    r = bench.bench_grid(_args(
+        grid_strategies="uncertainty,margin", grid_experiments=2,
+        sweep_pool=120, rounds_per_launch=2, window=10, no_baseline=True,
+    ))
+    assert r["grid_cells"] == 4
+    assert r["grid_cells_rounds_per_second"] > 0
+    assert r["grid_launches"] >= 2
+    assert r["recompiles_after_warmup"] == 0
+    assert "grid_speedup" not in r
+    assert r["baseline_skipped"] == {"reason": "no_baseline_flag"}
+
+
+@pytest.mark.slow  # serial S x E loop: four chunked compiles
+def test_bench_grid_speedup_leg(bench):
+    r = bench.bench_grid(_args(
+        grid_strategies="uncertainty,margin", grid_experiments=2,
+        sweep_pool=120, rounds_per_launch=2, window=10,
+    ))
+    assert r["serial_cells_rounds_per_second"] > 0
+    assert r["grid_speedup"] > 0

@@ -322,3 +322,138 @@ def test_cli_plot_writes_png(tmp_path):
     assert rc == 0
     data = out.read_bytes()
     assert data[:8] == b"\x89PNG\r\n\x1a\n" and len(data) > 1000
+
+
+def test_cli_grid_launch_strategies_and_sweep_seeds(capsys, tmp_path):
+    """--strategies a,b --sweep-seeds N routes through the grid launcher:
+    JSON lines carry strategy/seed tags, --out writes per-cell files, and
+    the stderr summary reports the recompile contract. "us" is the paper's
+    abbreviation for uncertainty sampling — the alias must normalize before
+    registry lookup, so every downstream tag says "uncertainty"."""
+    out = tmp_path / "curve.txt"
+    rc = main([
+        "--dataset", "checkerboard2x2", "--n-samples", "80",
+        "--strategies", "us,margin", "--sweep-seeds", "2",
+        "--fit", "device", "--window", "10", "--rounds", "2",
+        "--rounds-per-launch", "2", "--quiet", "--json", "--out", str(out),
+    ])
+    assert rc == 0
+    captured = capsys.readouterr()
+    lines = [json.loads(l) for l in captured.out.strip().splitlines()]
+    cells = {(l["strategy"], l["seed"]) for l in lines}
+    assert cells == {
+        ("uncertainty", 0), ("uncertainty", 1), ("margin", 0), ("margin", 1)
+    }
+    for strat in ("uncertainty", "margin"):
+        for seed in (0, 1):
+            assert (tmp_path / f"curve_{strat}_s{seed}.txt").exists()
+
+
+def test_cli_audit_covers_datasets_only_grid(monkeypatch):
+    """--datasets with no --strategies (or one entry) still launches the grid
+    program, so --audit must receive the exact group list run_grid gets — not
+    None, which would audit the never-launched chunk/sweep program instead."""
+    import distributed_active_learning_tpu.run as run_mod
+
+    seen = {}
+
+    def fake_audit(args, cfg=None, neural_strategy=None, grid_strategies=None):
+        seen["grid_strategies"] = grid_strategies
+        raise SystemExit(0)
+
+    monkeypatch.setattr(run_mod, "_audit_or_die", fake_audit)
+    with pytest.raises(SystemExit):
+        run_mod.main([
+            "--datasets", "checkerboard2x2,checkerboard4x4", "--audit",
+            "--rounds", "1", "--quiet",
+        ])
+    assert seen["grid_strategies"] == ["uncertainty"]
+
+
+def test_cli_audit_mesh_fallback_keeps_grid_group(monkeypatch):
+    """A mesh grid spec that cannot be audited here (too few devices) falls
+    back to the cpu program for the SAME custom strategy group — the registry
+    only carries the fixed uncertainty+margin+density grid spelling, so a
+    name-filtered registry fallback would trace zero programs and the gate
+    would pass having audited nothing."""
+    import distributed_active_learning_tpu.analysis as analysis_mod
+    import distributed_active_learning_tpu.run as run_mod
+    from distributed_active_learning_tpu.analysis.report import Report
+    from distributed_active_learning_tpu.config import ExperimentConfig, MeshConfig
+
+    calls = []
+
+    def fake_run_audit(specs, rules=None):
+        specs = list(specs)
+        calls.append(specs)
+        if len(calls) == 1:  # the mesh pass: every spec skipped
+            return Report(
+                skipped={s.name: "needs 8 devices, have 1" for s in specs}
+            )
+        rep = Report()
+        rep.programs.extend(s.name for s in specs)
+        return rep
+
+    monkeypatch.setattr(analysis_mod, "run_audit", fake_run_audit)
+    monkeypatch.setattr(analysis_mod, "lint_paths", lambda targets: [])
+    args = run_mod.build_parser().parse_args(["--quiet"])
+    cfg = ExperimentConfig(mesh=MeshConfig(data=4, model=2))
+    run_mod._audit_or_die(args, cfg=cfg, grid_strategies=["uncertainty", "margin"])
+    assert [s.name for s in calls[0]] == ["grid/uncertainty+margin/mesh4x2"]
+    assert [s.name for s in calls[1]] == ["grid/uncertainty+margin/cpu"]
+
+
+def test_cli_grid_rejects_unknown_and_stream_rounds(tmp_path):
+    with pytest.raises(SystemExit):
+        main(["--strategies", "uncertainty,nope", "--rounds", "1", "--quiet"])
+    # post-alias duplicates would run identical groups and overwrite each
+    # other's per-cell outputs
+    with pytest.raises(SystemExit):
+        main(["--strategies", "us,uncertainty", "--rounds", "1", "--quiet"])
+    with pytest.raises(SystemExit):
+        main([
+            "--datasets", "checkerboard2x2,checkerboard2x2",
+            "--rounds", "1", "--quiet",
+        ])
+    with pytest.raises(SystemExit):
+        main([
+            "--strategies", "uncertainty,margin", "--stream-rounds",
+            "--metrics-out", str(tmp_path / "m.jsonl"),
+            "--rounds", "1", "--quiet",
+        ])
+
+
+def test_cli_neural_sweep_seeds_routes_to_batched_loop(capsys, monkeypatch):
+    """--neural --sweep-seeds on a fusable deep strategy routes to the
+    batched neural sweep (stubbed here — the real batched-vs-serial parity
+    runs in tests/test_grid.py); greedy per-round strategies are refused
+    with guidance."""
+    from distributed_active_learning_tpu.runtime import neural_loop
+    from distributed_active_learning_tpu.runtime.results import (
+        ExperimentResult,
+        RoundRecord,
+    )
+
+    calls = {}
+
+    def fake_sweep(cfg, learner, x, y, tx, ty, seeds, **kw):
+        calls["seeds"] = list(seeds)
+        rec = RoundRecord(round=1, n_labeled=10, n_unlabeled=70, accuracy=0.5)
+        return [ExperimentResult(records=[rec]) for _ in seeds]
+
+    monkeypatch.setattr(neural_loop, "run_neural_sweep", fake_sweep)
+    rc = main([
+        "--neural", "--strategy", "deep.entropy",
+        "--dataset", "checkerboard2x2", "--n-samples", "80",
+        "--sweep-seeds", "2", "--window", "8", "--rounds", "1",
+        "--train-steps", "5", "--mc-samples", "2", "--quiet", "--json",
+    ])
+    assert rc == 0
+    assert calls["seeds"] == [0, 1]
+    lines = [json.loads(l) for l in capsys.readouterr().out.strip().splitlines()]
+    assert {l["seed"] for l in lines} == {0, 1}
+    with pytest.raises(SystemExit):
+        main([
+            "--neural", "--strategy", "deep.batchbald", "--sweep-seeds", "2",
+            "--rounds", "1", "--quiet",
+        ])

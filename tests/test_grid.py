@@ -1,0 +1,358 @@
+"""Grid launcher (runtime/sweep.py run_grid): per-cell parity with serial.
+
+The grid launch stream exists purely to amortize launches/compiles across the
+paper's whole results matrix (strategies x seeds x datasets); it must never
+change any cell's results. Pinned here: per-cell records bit-identical to
+serial ``run_experiment`` runs for heterogeneous strategy groups (CPU and the
+4x2 mesh), the batched dataset axis (unequal pool widths through the fill
+watermark; the equal-width twin and staggered budget stops run as slow
+variants), mid-grid checkpoint refusal + resume, the neural sweep's
+seed-batched TrainState carry, and the one-compile-for-the-matrix contract
+(``recompiles_after_warmup == 0``). Grid compiles dominate tier-1 cost, so
+every tier-1 test keeps a tiny shape and the wide E x S acceptance variants
+are slow-marked.
+"""
+
+import dataclasses
+import os
+
+import numpy as np
+import pytest
+
+from distributed_active_learning_tpu.config import (
+    DataConfig,
+    ExperimentConfig,
+    ForestConfig,
+    MeshConfig,
+    StrategyConfig,
+)
+from distributed_active_learning_tpu.data.datasets import DataBundle
+from distributed_active_learning_tpu.runtime.loop import run_experiment
+from distributed_active_learning_tpu.runtime.sweep import run_grid
+
+STRATEGIES = ["uncertainty", "margin", "density"]
+SEEDS = [0, 1]
+
+
+def _cfg(**kw):
+    return ExperimentConfig(
+        data=kw.pop(
+            "data", DataConfig(name="checkerboard2x2", n_samples=160, seed=2)
+        ),
+        # fit_budget pinned: the bootstrap draw depends on the fit window's
+        # static size and the grid shares ONE fit program — the run_sweep
+        # parity caveat applies to every cell.
+        forest=kw.pop(
+            "forest",
+            ForestConfig(n_trees=6, max_depth=3, fit="device", fit_budget=160),
+        ),
+        strategy=kw.pop(
+            "strategy", StrategyConfig(name="uncertainty", window_size=10)
+        ),
+        n_start=10,
+        max_rounds=kw.pop("max_rounds", 3),
+        seed=kw.pop("seed", 0),
+        rounds_per_launch=kw.pop("rounds_per_launch", 2),
+        log_every=0,
+        **kw,
+    )
+
+
+def _serial_cell(cfg, cell, bundle=None):
+    scfg = dataclasses.replace(
+        cfg,
+        seed=cell.seed,
+        rounds_per_launch=1,
+        data=dataclasses.replace(cfg.data, name=cell.dataset),
+        strategy=dataclasses.replace(
+            cfg.strategy, name=cell.strategy, window_size=cell.window
+        ),
+    )
+    return run_experiment(scfg, bundle=bundle)
+
+
+def _assert_cell_matches(cell, serial_res):
+    got = [(r.round, r.n_labeled, r.accuracy) for r in cell.result.records]
+    want = [(r.round, r.n_labeled, r.accuracy) for r in serial_res.records]
+    # Bit-identical, not allclose: the grid runs the SAME jitted fit/round/
+    # accuracy programs, only vmapped over the cell axes.
+    assert got == want, (cell.strategy, cell.dataset, cell.seed)
+
+
+@pytest.fixture(scope="module")
+def hetero_grid():
+    """The headline shape — 3 heterogeneous strategy groups x 2 seeds in one
+    launch stream, metrics riding the batched scan — run once for the whole
+    module; the parity/metrics/contract/helpers tests all consume it."""
+    cfg = _cfg(collect_metrics=True)
+    return cfg, run_grid(cfg, STRATEGIES, SEEDS)
+
+
+def test_grid_hetero_strategies_bit_identical(hetero_grid):
+    cfg, grid = hetero_grid
+    assert len(grid.cells) == len(STRATEGIES) * len(SEEDS)
+    assert not grid.serial_fallback
+    for cell in grid.cells:
+        serial = _serial_cell(cfg, cell)
+        _assert_cell_matches(cell, serial)
+        # RoundMetrics rode the batched scan ys and match the serial metrics
+        # program bit-for-bit (vmap is never semantic).
+        assert all(r.metrics is not None for r in cell.result.records)
+        for got, want in zip(cell.result.records, serial.records):
+            assert got.metrics == want.metrics
+
+
+def test_grid_one_compile_for_the_matrix(hetero_grid):
+    """The acceptance contract: after the first grid launch the compiled
+    program is reused — zero recompiles across the whole matrix."""
+    _cfg_, grid = hetero_grid
+    assert grid.launches >= 2  # 3 rounds at K=2: two chunk launches
+    assert grid.recompiles_after_warmup == 0
+
+
+def test_grid_result_helpers_and_band_plot(hetero_grid, tmp_path):
+    from distributed_active_learning_tpu.runtime.results import (
+        grid_curves,
+        plot_grid_bands,
+    )
+
+    _cfg_, grid = hetero_grid
+    cell = grid.cell("margin", "checkerboard2x2", 1)
+    assert cell.strategy == "margin" and cell.seed == 1
+    assert len(grid.results_for("density")) == len(SEEDS)
+    curves = grid_curves(grid)
+    assert set(curves) == {(s, "checkerboard2x2") for s in STRATEGIES}
+    _grid_axis, accs = curves[("uncertainty", "checkerboard2x2")]
+    assert accs.shape[0] == len(SEEDS)
+    png = os.path.join(tmp_path, "grid.png")
+    assert plot_grid_bands(grid, png) == png
+    assert os.path.getsize(png) > 0
+
+
+def _bundle(n, seed, d=6):
+    r = np.random.default_rng(seed)
+    x = r.normal(size=(n, d)).astype(np.float32)
+    y = (x[:, 0] + 0.3 * x[:, 1] > 0).astype(np.int32)
+    tx = r.normal(size=(100, d)).astype(np.float32)
+    ty = (tx[:, 0] + 0.3 * tx[:, 1] > 0).astype(np.int32)
+    return DataBundle(train_x=x, train_y=y, test_x=tx, test_y=ty, name=f"p{n}")
+
+
+def test_grid_dataset_axis_unequal_widths_and_checkpoint(tmp_path):
+    """The batched dataset axis at its hardest: pools of DIFFERENT widths
+    padded to one slab, riding PoolState's dynamic fill watermark — padding
+    rows are labeled sentinels excluded from fit gathers and counts, so
+    cells match unpadded serial runs bit-for-bit (parity needs fit_budget <=
+    the smallest pool: one shared fit program, bootstrap shaped by its
+    static window). The same run exercises the gridstate checkpoint format:
+    files land at chunk boundaries and a different grid (other strategy
+    axis) refuses the positional state."""
+    bundles = {"p120": _bundle(120, 1), "p200": _bundle(200, 2)}
+    ckpt = os.path.join(tmp_path, "ckpt")
+    cfg = _cfg(
+        max_rounds=2,
+        data=DataConfig(name="p120"),
+        forest=ForestConfig(n_trees=6, max_depth=3, fit="device", fit_budget=96),
+        checkpoint_dir=ckpt,
+        checkpoint_every=1,
+    )
+    grid = run_grid(
+        cfg, ["uncertainty"], [0], datasets=["p120", "p200"], bundles=bundles
+    )
+    assert not grid.serial_fallback
+    no_ckpt = dataclasses.replace(cfg, checkpoint_dir=None, checkpoint_every=0)
+    for cell in grid.cells:
+        _assert_cell_matches(
+            cell, _serial_cell(no_ckpt, cell, bundle=bundles[cell.dataset])
+        )
+    assert any(f.startswith("gridstate_") for f in os.listdir(ckpt))
+    with pytest.raises(ValueError, match="refusing to resume"):
+        run_grid(
+            cfg, ["margin"], [0], datasets=["p120", "p200"], bundles=bundles
+        )
+
+
+def test_grid_falls_back_to_serial_for_host_fit():
+    cfg = _cfg(
+        forest=ForestConfig(n_trees=6, max_depth=3, fit="host"),
+        max_rounds=2,
+    )
+    grid = run_grid(cfg, ["uncertainty", "margin"], [0])
+    assert grid.serial_fallback
+    for cell in grid.cells:
+        _assert_cell_matches(cell, _serial_cell(cfg, cell))
+        assert all(r.train_time > 0 for r in cell.result.records)
+
+
+def test_grid_on_sharded_mesh(devices):
+    """Heterogeneous groups under the 4x2 mesh (gemm kernel for compile
+    weight): batching, grouping, and sharding are all placement/launch
+    decisions, never semantic ones. The pallas rewrap and wider grids run
+    in the slow acceptance variant."""
+    cfg = dataclasses.replace(
+        _cfg(max_rounds=2, forest=ForestConfig(
+            n_trees=8, max_depth=3, fit="device", kernel="gemm", fit_budget=160,
+        )),
+        mesh=MeshConfig(data=4, model=2),
+    )
+    grid = run_grid(cfg, ["uncertainty", "entropy"], [5])
+    single = dataclasses.replace(cfg, mesh=MeshConfig())
+    for cell in grid.cells:
+        base = _serial_cell(single, cell)
+        assert [r.n_labeled for r in cell.result.records] == [
+            r.n_labeled for r in base.records
+        ]
+        np.testing.assert_allclose(
+            [r.accuracy for r in cell.result.records],
+            [r.accuracy for r in base.records],
+            atol=1e-6,
+        )
+
+
+# --- the neural sweep: TrainState carry batched like the mask ---------------
+
+
+def _neural_setup():
+    import jax
+
+    from distributed_active_learning_tpu.data.synthetic import make_checkerboard
+    from distributed_active_learning_tpu.models.neural import MLP, NeuralLearner
+    from distributed_active_learning_tpu.runtime.neural_loop import (
+        NeuralExperimentConfig,
+    )
+
+    kx, kt = jax.random.split(jax.random.key(0))
+    x, y = make_checkerboard(kx, 120, grid=2)
+    tx, ty = make_checkerboard(kt, 200, grid=2)
+    learner = NeuralLearner(
+        MLP(n_classes=2, hidden=(16,)), (2,), train_steps=8, mc_samples=4
+    )
+    cfg = NeuralExperimentConfig(
+        strategy="entropy", window_size=8, n_start=10, max_rounds=3,
+        rounds_per_launch=2, seed=0,
+    )
+    return cfg, learner, x, y, tx, ty
+
+
+def test_neural_sweep_bit_identical_to_serial():
+    from distributed_active_learning_tpu.runtime.neural_loop import (
+        run_neural_experiment,
+        run_neural_sweep,
+    )
+
+    cfg, learner, x, y, tx, ty = _neural_setup()
+    seeds = [0, 1]
+    sweep = run_neural_sweep(cfg, learner, x, y, tx, ty, seeds)
+    for s, res in zip(seeds, sweep):
+        base = run_neural_experiment(
+            dataclasses.replace(cfg, seed=s, rounds_per_launch=1),
+            learner, x, y, tx, ty,
+        )
+        got = [(r.round, r.n_labeled, r.accuracy) for r in res.records]
+        want = [(r.round, r.n_labeled, r.accuracy) for r in base.records]
+        assert got == want, f"seed {s}"
+
+
+def test_neural_sweep_refuses_checkpointing():
+    from distributed_active_learning_tpu.runtime.neural_loop import (
+        run_neural_sweep,
+    )
+
+    cfg, learner, x, y, tx, ty = _neural_setup()
+    with pytest.raises(ValueError, match="not supported"):
+        run_neural_sweep(
+            dataclasses.replace(cfg, checkpoint_dir="/tmp/x", checkpoint_every=1),
+            learner, x, y, tx, ty, [0, 1],
+        )
+
+
+# --- slow variants: staggered stops, equal-width dataset axis, resume, ------
+# --- wide E x S acceptance grids, mesh pallas -------------------------------
+
+
+@pytest.mark.slow
+def test_grid_staggered_budget_stops_across_groups():
+    """Per-strategy windows (5/15) against a shared label budget: groups hit
+    the budget at different rounds, finished cells freeze as masked no-ops
+    while the laggard group continues — and every cell stays bit-identical
+    to its serial run at that window."""
+    cfg = _cfg(label_budget=30, max_rounds=100)
+    grid = run_grid(cfg, ["uncertainty", "margin"], [0], windows=[5, 15])
+    lengths = [len(c.result.records) for c in grid.cells]
+    assert len(set(lengths)) > 1  # genuinely staggered stops
+    for cell in grid.cells:
+        _assert_cell_matches(cell, _serial_cell(cfg, cell))
+
+
+@pytest.mark.slow
+def test_grid_dataset_axis_equal_widths():
+    """Two equal-size pools vmapped outside the seed axis: no padding, so
+    even RNG-shaped draws match serial exactly (the unequal-width twin runs
+    tier-1 through the fill watermark)."""
+    cfg = _cfg(max_rounds=2)
+    grid = run_grid(
+        cfg, ["uncertainty", "entropy"], [0],
+        datasets=["checkerboard2x2", "checkerboard4x4"],
+    )
+    assert len(grid.cells) == 4
+    assert not grid.serial_fallback
+    for cell in grid.cells:
+        _assert_cell_matches(cell, _serial_cell(cfg, cell))
+
+
+@pytest.mark.slow  # the resume re-drives the grid twice plus serial baselines
+def test_grid_checkpoint_resume_mid_grid(tmp_path):
+    """One gridstate checkpoint covers every cell; a resumed grid continues
+    each cell from its frozen round, donation stays ON (no warnings), and
+    the stitched curves are bit-identical to uninterrupted serial runs."""
+    import warnings
+
+    ckpt = os.path.join(tmp_path, "ckpt")
+    half = _cfg(max_rounds=3, checkpoint_dir=ckpt, checkpoint_every=1)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        run_grid(half, ["uncertainty", "margin"], SEEDS)
+    donation = [
+        str(w.message) for w in caught if "donat" in str(w.message).lower()
+    ]
+    assert donation == []
+    resumed = run_grid(
+        dataclasses.replace(half, max_rounds=2), ["uncertainty", "margin"], SEEDS
+    )
+    full = _cfg(max_rounds=5)
+    for cell in resumed.cells:
+        assert [r.round for r in cell.result.records] == [1, 2, 3, 4, 5]
+        _assert_cell_matches(cell, _serial_cell(full, cell))
+
+
+@pytest.mark.slow
+def test_grid_acceptance_three_strategies_four_seeds_cpu():
+    """The acceptance shape: --strategies us,margin,density --sweep-seeds 4,
+    every cell bit-identical to the serial S x E loop."""
+    cfg = _cfg(max_rounds=3)
+    grid = run_grid(cfg, STRATEGIES, [0, 1, 2, 3])
+    assert len(grid.cells) == 12
+    assert grid.recompiles_after_warmup == 0
+    for cell in grid.cells:
+        _assert_cell_matches(cell, _serial_cell(cfg, cell))
+
+
+@pytest.mark.slow
+def test_grid_acceptance_mesh_pallas(devices):
+    """Heterogeneous groups on the 4x2 mesh with the pallas kernel: the
+    shard_map-wrapped fused kernel re-wraps per cell inside the doubly
+    vmapped scan."""
+    cfg = dataclasses.replace(
+        _cfg(max_rounds=3, forest=ForestConfig(
+            n_trees=8, max_depth=3, fit="device", kernel="pallas",
+            fit_budget=160,
+        )),
+        mesh=MeshConfig(data=4, model=2),
+    )
+    grid = run_grid(cfg, ["uncertainty", "margin"], [0, 1])
+    single = dataclasses.replace(cfg, mesh=MeshConfig())
+    for cell in grid.cells:
+        base = _serial_cell(single, cell)
+        got = [(r.round, r.n_labeled, r.accuracy) for r in cell.result.records]
+        want = [(r.round, r.n_labeled, r.accuracy) for r in base.records]
+        assert got == want, (cell.strategy, cell.seed)

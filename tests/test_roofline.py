@@ -241,6 +241,38 @@ def test_summarize_metrics_roofline_section():
     assert "(error)" in out
 
 
+def test_summarize_metrics_grid_section():
+    """Round events tagged strategy/dataset/seed (run_grid's stream) fold
+    into the grid summary table: per-(strategy, dataset) final-accuracy
+    bands, frozen-cell counts, cell totals."""
+    sm = _load_by_path("summarize_metrics", "benches/summarize_metrics.py")
+    events = []
+    t = 1.0
+    for strat, accs in (("uncertainty", (0.6, 0.8)), ("margin", (0.5, 0.7))):
+        for seed in (0, 1):
+            for rnd, acc in enumerate(accs, start=1):
+                if strat == "margin" and seed == 1 and rnd == 2:
+                    continue  # this cell froze a round early
+                events.append({
+                    "ts": (t := t + 0.1), "kind": "round", "round": rnd,
+                    "strategy": strat, "dataset": "checkerboard2x2",
+                    "seed": seed, "n_labeled": 10 * rnd, "accuracy": acc,
+                })
+    out = sm.summarize(events)
+    assert "== grid ==" in out
+    section = out.split("== grid ==")[1]
+    assert "4 cells" in section
+    assert "uncertainty" in section and "margin" in section
+    unc_row = next(
+        ln for ln in section.splitlines() if ln.startswith("uncertainty")
+    )
+    assert "80.00 +/- 0.00" in unc_row  # both seeds finished at 0.8
+    margin_row = next(
+        ln for ln in section.splitlines() if ln.startswith("margin")
+    )
+    assert " 1 " in margin_row  # one frozen cell (stopped a round early)
+
+
 def test_summarize_metrics_serve_latency_by_cause():
     sm = _load_by_path("summarize_metrics", "benches/summarize_metrics.py")
     events = [
@@ -267,6 +299,31 @@ def test_summarize_metrics_serve_latency_by_cause():
 # ---------------------------------------------------------------------------
 # the regression sentinel
 # ---------------------------------------------------------------------------
+
+
+def test_compare_grid_metrics_in_vocabulary(compare_bench):
+    """The sentinel's vocabulary covers the grid mode: throughput drops fire
+    as soft regressions, a recompile past warmup fires HARD."""
+    base = {
+        "grid_cells_rounds_per_second": 10.0, "grid_speedup": 7.0,
+        "recompiles_after_warmup": 0,
+    }
+    cur = {
+        "grid_cells_rounds_per_second": 5.0, "grid_speedup": 2.0,
+        "recompiles_after_warmup": 1,
+    }
+    report = compare_bench.compare_payloads(base, cur)
+    assert "grid_cells_rounds_per_second" in report["regressions"]
+    assert "grid_speedup" in report["regressions"]
+    assert report["hard_regressions"] == ["recompiles_after_warmup"]
+
+    # A --mode all artifact: serve's clean bare counter overwrites grid's in
+    # the merged payload, but the namespaced twin still fires HARD.
+    report = compare_bench.compare_payloads(
+        {"recompiles_after_warmup": 0, "grid_recompiles_after_warmup": 0},
+        {"recompiles_after_warmup": 0, "grid_recompiles_after_warmup": 1},
+    )
+    assert report["hard_regressions"] == ["grid_recompiles_after_warmup"]
 
 
 def test_compare_r03_r04_names_the_mfu_regression(compare_bench):
