@@ -63,6 +63,9 @@ DEFAULT_SPECS: List[MetricSpec] = [
     MetricSpec("scan_seconds_per_round", "lower", 0.30),
     MetricSpec("per_round_driver_seconds_per_round", "lower", 0.35),
     MetricSpec("scan_fusion_speedup", "higher", 0.30),
+    # the PR-10 round megakernel: fused vs unfused chunk, same inputs
+    MetricSpec("fused_scan_seconds_per_round", "lower", 0.30),
+    MetricSpec("fused_round_speedup", "higher", 0.25),
     MetricSpec("pipelined_seconds_per_round", "lower", 0.30),
     MetricSpec("touchdown_hidden_fraction", "higher", 0.50),
     # sweep / grid / serve / lal / neural
@@ -85,6 +88,11 @@ DEFAULT_SPECS: List[MetricSpec] = [
     # bare counter overwrites grid's (bench.py bench_grid)
     MetricSpec(
         "grid_recompiles_after_warmup", "lower", 0.0, kind="counter", hard=True
+    ),
+    # round mode's namespaced twin (same --mode all merge hazard)
+    MetricSpec(
+        "fused_round_recompiles_after_warmup", "lower", 0.0, kind="counter",
+        hard=True,
     ),
     MetricSpec("chunk_jit_cache_entries", "lower", 0.0, kind="counter"),
 ]

@@ -3,34 +3,51 @@
 # and the AG-News BatchBALD arm (plus its random control) at 5 seeds each, on
 # the recalibrated stand-in pools. Runs on the real chip; logs land in
 # results/deep_multiseed/ in the reference's stdout format.
+#
+# PR-10 port onto the batched launch stream: each arm is ONE `--sweep-seeds 5`
+# invocation of the neural seed sweep (runtime/neural_loop.py
+# make_neural_sweep_chunk_fn — the TrainState carry batched [E], one compile
+# serving all seeds), instead of 5 serial runs. Every arm fuses — PR 10
+# folded the greedy batch selects (badge/batchbald) into the scan, so none
+# of these drop to the per-round loop. 30 serial runs became 6 invocations.
+# Per-seed files come out as `<stem>_s<seed>.txt` and are renamed to the
+# legacy `<stem>_seed<seed>.txt` the summarize script globs.
 set -u
 cd "$(dirname "$0")/.."
 OUT=results/deep_multiseed
 mkdir -p "$OUT"
 
-run () { # $1 log name, rest: CLI args
-  local log="$OUT/$1"; shift
-  if [ -s "$log" ]; then echo "skip $log (exists)"; return; fi
-  echo "=== $log"
-  python -m distributed_active_learning_tpu.run "$@" --out "$log" --quiet \
-    || echo "FAILED: $log"
+SEEDS=5
+
+run_arm () { # $1 log stem (sans .txt), rest: CLI args
+  local stem="$OUT/$1"; shift
+  # skip-if-exists at arm granularity: all per-seed legacy files present
+  local have=0
+  for ((s = 0; s < SEEDS; s++)); do
+    [ -s "${stem}_seed${s}.txt" ] && have=$((have + 1))
+  done
+  if [ "$have" -eq "$SEEDS" ]; then echo "skip $stem (exists)"; return; fi
+  echo "=== $stem (sweep of $SEEDS seeds)"
+  python -m distributed_active_learning_tpu.run "$@" \
+    --seed 0 --sweep-seeds "$SEEDS" --out "${stem}.txt" --quiet \
+    || { echo "FAILED: $stem"; return; }
+  # legacy naming for benches/summarize_deep_multiseed.py
+  for ((s = 0; s < SEEDS; s++)); do
+    [ -s "${stem}_s${s}.txt" ] && mv "${stem}_s${s}.txt" "${stem}_seed${s}.txt"
+  done
 }
 
-for seed in 0 1 2 3 4; do
-  for arm in entropy random badge density; do
-    run "cifar10_cnn_deep_${arm}_window_100_seed${seed}.txt" \
-      --dataset cifar10 --neural --model cnn --strategy "deep.${arm}" \
-      --n-samples 6000 --window 100 --rounds 20 --n-start 20 \
-      --train-steps 400 --mc-samples 8 --seed "$seed"
-  done
+for arm in entropy random badge density; do
+  run_arm "cifar10_cnn_deep_${arm}_window_100" \
+    --dataset cifar10 --neural --model cnn --strategy "deep.${arm}" \
+    --n-samples 6000 --window 100 --rounds 20 --n-start 20 \
+    --train-steps 400 --mc-samples 8
 done
 
-for seed in 0 1 2 3 4; do
-  for arm in batchbald random; do
-    run "agnews_transformer_deep_${arm}_window_50_seed${seed}.txt" \
-      --dataset agnews --neural --model transformer --strategy "deep.${arm}" \
-      --n-samples 4000 --window 50 --rounds 20 --n-start 16 \
-      --train-steps 400 --mc-samples 8 --seed "$seed"
-  done
+for arm in batchbald random; do
+  run_arm "agnews_transformer_deep_${arm}_window_50" \
+    --dataset agnews --neural --model transformer --strategy "deep.${arm}" \
+    --n-samples 4000 --window 50 --rounds 20 --n-start 16 \
+    --train-steps 400 --mc-samples 8
 done
 echo ALL_DONE

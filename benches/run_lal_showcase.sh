@@ -4,33 +4,75 @@
 # seed) on the reference's own checkerboard2x2 fixture files, LAL's
 # 2000-tree error-reduction regressor trained on the reference-scale
 # Monte-Carlo dataset. Skip-if-exists, so re-running only adds new seeds.
+#
+# PR-10 port onto the grid launch stream (runtime/sweep.py run_grid): each
+# fixed-dataset block is ONE `--strategies lal,uncertainty,random
+# --sweep-seeds N` invocation — strategy-major cells share one batched fit
+# per round and the whole block compiles once. gaussian_unbalanced is the
+# exception: each seed draws a FRESH problem (the paired-delta evidence in
+# results/README.md depends on that), and a grid shares one pool across its
+# seed axis — so that block stays per-seed but still grids the STRATEGY
+# axis (one invocation per seed serves all three arms off one fit).
+# 60 serial runs became 12 invocations. Per-cell files come out as
+# `<stem>_<strategy>_s<seed>.txt` and are renamed to the legacy
+# `<prefix>_dist{LAL,US,RAND}_window_1_seed<seed>.txt` the summarize
+# script globs.
 set -u
 cd "$(dirname "$0")/.."
 OUT=results/lal_showcase
 FIX=tests/fixtures
 mkdir -p "$OUT"
 
-run () { # $1 log name, rest: CLI args
-  local log="$OUT/$1"; shift
-  if [ -s "$log" ]; then echo "skip $log (exists)"; return; fi
-  echo "=== $log"
-  python -m distributed_active_learning_tpu.run "$@" --out "$log" --quiet \
-    || echo "FAILED: $log"
+# strategy spelling -> legacy arm suffix
+arm_of () {
+  case "$1" in
+    lal) echo distLAL ;;
+    uncertainty) echo distUS ;;
+    random) echo distRAND ;;
+  esac
 }
 
-for seed in 0 1 2 3 4; do
-  common=(--dataset checkerboard2x2_file --data-path "$FIX/reference_data"
-          --trees 50 --depth 8 --fit device --window 1 --rounds 200
-          --n-start 2 --seed "$seed")
-  run "checkerboard2x2_distLAL_window_1_seed${seed}.txt" "${common[@]}" \
-    --strategy lal \
+have_all () { # $1 prefix, $2 n_seeds: all legacy files for every arm present?
+  local prefix="$1" n="$2" s arm
+  for ((s = 0; s < n; s++)); do
+    for arm in distLAL distUS distRAND; do
+      [ -s "$OUT/${prefix}_${arm}_window_1_seed${s}.txt" ] || return 1
+    done
+  done
+  return 0
+}
+
+rename_cells () { # $1 prefix, $2 first seed, $3 n seeds
+  local prefix="$1" s0="$2" n="$3" s strat
+  for ((s = s0; s < s0 + n; s++)); do
+    for strat in lal uncertainty random; do
+      local src="$OUT/${prefix}_${strat}_s${s}.txt"
+      [ -s "$src" ] && mv "$src" \
+        "$OUT/${prefix}_$(arm_of "$strat")_window_1_seed${s}.txt"
+    done
+  done
+}
+
+run_grid_block () { # $1 prefix, $2 first seed, $3 n seeds, rest: CLI args
+  local prefix="$1" s0="$2" n="$3"; shift 3
+  echo "=== $prefix (grid: 3 strategies x $n seeds)"
+  python -m distributed_active_learning_tpu.run "$@" \
+    --strategies lal,uncertainty,random \
+    --seed "$s0" --sweep-seeds "$n" \
     --strategy-option "lal_data_path=$FIX/lal_simulatedunbalanced_big.txt" \
-    --strategy-option lal_trees=2000
-  run "checkerboard2x2_distUS_window_1_seed${seed}.txt" "${common[@]}" \
-    --strategy uncertainty
-  run "checkerboard2x2_distRAND_window_1_seed${seed}.txt" "${common[@]}" \
-    --strategy random
-done
+    --strategy-option lal_trees=2000 \
+    --out "$OUT/${prefix}.txt" --quiet \
+    || { echo "FAILED: $prefix"; return; }
+  rename_cells "$prefix" "$s0" "$n"
+}
+
+common=(--trees 50 --depth 8 --fit device --window 1 --rounds 200 --n-start 2)
+
+if have_all checkerboard2x2 5; then echo "skip checkerboard2x2 (exists)"; else
+  run_grid_block checkerboard2x2 0 5 \
+    --dataset checkerboard2x2_file --data-path "$FIX/reference_data" \
+    "${common[@]}"
+fi
 
 # r5: LAL's home turf — the reference's DatasetSimulatedUnbalanced geometry
 # (classes/test.py:150-187), the very distribution the 2000-tree regressor's
@@ -38,34 +80,31 @@ done
 # unbalanced problem; this is where Konyushkova et al. built LAL to win
 # (the checkerboard arm above lands a statistical tie). 10 seeds — the
 # committed paired-delta evidence (results/README.md) is 10 problems.
+# Per-seed invocations (fresh problem per seed), strategy axis gridded.
+have_seed () { # $1 prefix, $2 seed: all three arm files for ONE seed present?
+  local prefix="$1" s="$2" arm
+  for arm in distLAL distUS distRAND; do
+    [ -s "$OUT/${prefix}_${arm}_window_1_seed${s}.txt" ] || return 1
+  done
+  return 0
+}
+
 for seed in 0 1 2 3 4 5 6 7 8 9; do
-  common=(--dataset gaussian_unbalanced
-          --trees 50 --depth 8 --fit device --window 1 --rounds 200
-          --n-start 2 --seed "$seed")
-  run "gaussian_unbalanced_distLAL_window_1_seed${seed}.txt" "${common[@]}" \
-    --strategy lal \
-    --strategy-option "lal_data_path=$FIX/lal_simulatedunbalanced_big.txt" \
-    --strategy-option lal_trees=2000
-  run "gaussian_unbalanced_distUS_window_1_seed${seed}.txt" "${common[@]}" \
-    --strategy uncertainty
-  run "gaussian_unbalanced_distRAND_window_1_seed${seed}.txt" "${common[@]}" \
-    --strategy random
+  if have_seed gaussian_unbalanced "$seed"; then
+    echo "skip gaussian_unbalanced seed $seed (exists)"; continue
+  fi
+  run_grid_block gaussian_unbalanced "$seed" 1 \
+    --dataset gaussian_unbalanced "${common[@]}"
 done
 
 # r5: rotated checkerboard (the reference's own fixture files) — the
 # geometry where batch-US's pathology is strongest, i.e. the motivating
 # example for LAL as the remedy. 5 seeds.
-for seed in 0 1 2 3 4; do
-  common=(--dataset rotated_checkerboard2x2_file --data-path "$FIX/reference_data"
-          --trees 50 --depth 8 --fit device --window 1 --rounds 200
-          --n-start 2 --seed "$seed")
-  run "rotated_checkerboard2x2_distLAL_window_1_seed${seed}.txt" "${common[@]}" \
-    --strategy lal \
-    --strategy-option "lal_data_path=$FIX/lal_simulatedunbalanced_big.txt" \
-    --strategy-option lal_trees=2000
-  run "rotated_checkerboard2x2_distUS_window_1_seed${seed}.txt" "${common[@]}" \
-    --strategy uncertainty
-  run "rotated_checkerboard2x2_distRAND_window_1_seed${seed}.txt" "${common[@]}" \
-    --strategy random
-done
+if have_all rotated_checkerboard2x2 5; then
+  echo "skip rotated_checkerboard2x2 (exists)"
+else
+  run_grid_block rotated_checkerboard2x2 0 5 \
+    --dataset rotated_checkerboard2x2_file --data-path "$FIX/reference_data" \
+    "${common[@]}"
+fi
 echo ALL_DONE

@@ -42,6 +42,9 @@ class AuditUnit:
     with_metrics: bool = False
     carry_in_argnums: Optional[Tuple[int, ...]] = None
     carry_out_index: Optional[int] = None
+    # Quantized forest storage this program was built with ("bf16"/"int8");
+    # None = unquantized. The quantized-leaf-upcast rule fires on it.
+    quantize: Optional[str] = None
 
 
 class TracedUnit:
@@ -55,6 +58,7 @@ class TracedUnit:
         self.allows_callbacks = unit.allows_callbacks
         self.expect_donation = unit.expect_donation
         self.with_metrics = unit.with_metrics
+        self.quantize = unit.quantize
         self._traced = unit.fn.trace(*unit.args)
         self._eqn_sites = None
         self._avals = None

@@ -7,6 +7,11 @@ without data, devices beyond the host, or compilation:
 
 - ``chunk``        — the scan-fused forest AL chunk (runtime/loop.py
                      ``make_chunk_fn``), per registered strategy;
+- ``fused_chunk``  — the round-megakernel chunk (``fused_round=True``:
+                     eval -> score -> top-k in one pass, ops/round_fused.py)
+                     per megakernel-served strategy, plus quantized-storage
+                     variants (``uncertainty-bf16`` / ``uncertainty-int8``)
+                     audited by the ``quantized-leaf-upcast`` rule;
 - ``sweep``        — the vmapped experiment-batched chunk (runtime/sweep.py
                      ``make_sweep_chunk_fn``), per registered strategy;
 - ``neural_chunk`` — the fused neural AL chunk (runtime/neural_loop.py
@@ -54,7 +59,10 @@ SWEEP_E = 3
 LABEL_CAP = 40
 FIT_BUDGET = 48
 
-KINDS = ("chunk", "sweep", "grid", "neural_sweep", "neural_chunk", "serve")
+KINDS = (
+    "chunk", "fused_chunk", "sweep", "grid", "neural_sweep", "neural_chunk",
+    "serve",
+)
 GRID_D = 2   # datasets in the audited grid program
 GRID_E = 2   # seeds per (strategy, dataset)
 GRID_STRATEGIES = ("uncertainty", "margin", "density")  # heterogeneous groups
@@ -135,7 +143,7 @@ def _mesh_or_skip(shape=MESH_SHAPE):
     return make_mesh(data=data, model=model)
 
 
-def _forest_cfg(kernel: str):
+def _forest_cfg(kernel: str, quantize: str = "none"):
     from distributed_active_learning_tpu.config import (
         ExperimentConfig,
         ForestConfig,
@@ -145,17 +153,19 @@ def _forest_cfg(kernel: str):
     return ExperimentConfig(
         forest=ForestConfig(
             n_trees=N_TREES, max_depth=MAX_DEPTH, max_bins=MAX_BINS,
-            kernel=kernel, fit="device",
+            kernel=kernel, fit="device", quantize=quantize,
         ),
         strategy=StrategyConfig(name="uncertainty", window_size=WINDOW),
     )
 
 
-def _device_fit(kernel: str):
+def _device_fit(kernel: str, quantize: str = "none"):
     from distributed_active_learning_tpu.runtime.loop import make_device_fit
 
     edges = jnp.zeros((FEATURES, MAX_BINS - 1), jnp.float32)
-    return make_device_fit(_forest_cfg(kernel), edges, FIT_BUDGET, n_classes=2)
+    return make_device_fit(
+        _forest_cfg(kernel, quantize), edges, FIT_BUDGET, n_classes=2
+    )
 
 
 def _strategy_and_aux(name: str):
@@ -205,6 +215,64 @@ def _build_chunk(
         carry_in_argnums=(1,),
         carry_out_index=0,
     )
+
+
+def _build_fused_chunk(
+    name: str, placement: str, mesh_shape=MESH_SHAPE
+) -> AuditUnit:
+    """The round-megakernel chunk (``fused_round=True``): eval -> score ->
+    top-k in one pass over the pool slab (ops/round_fused.py). ``name`` is
+    ``strategy`` or ``strategy-quantize`` (``uncertainty-int8``): quantized
+    variants audit the narrow-storage invariant via the
+    ``quantized-leaf-upcast`` rule, with the fit program quantizing in-trace.
+    Metrics are structurally off — the megakernel exists to avoid
+    materializing the score vector the metrics reductions would consume."""
+    from distributed_active_learning_tpu.runtime.loop import make_chunk_fn
+
+    strategy_name, _, quantize = name.partition("-")
+    quantize = quantize or "none"
+    mesh = _mesh_or_skip(mesh_shape) if placement != "cpu" else None
+    kernel = "pallas" if mesh is not None else "gemm"
+    strategy, aux = _strategy_and_aux(strategy_name)
+    chunk_fn = make_chunk_fn(
+        strategy, WINDOW, CHUNK_ROUNDS, _device_fit(kernel, quantize),
+        LABEL_CAP,
+        mesh=mesh,
+        wrap_pallas=mesh is not None,
+        with_metrics=False,
+        n_classes=2,
+        fused_round=True,
+    )
+    args = (
+        _sds((POOL_ROWS, FEATURES), jnp.int32),     # codes
+        _abstract_state(),                           # state (donated carry)
+        aux,
+        _key_sds(),                                  # fit_key
+        _sds((TEST_ROWS, FEATURES), jnp.float32),    # test_x
+        _sds((TEST_ROWS,), jnp.int32),               # test_y
+        _sds((), jnp.int32),                         # end_round
+    )
+    return AuditUnit(
+        name=f"fused_chunk/{name}/{placement}",
+        fn=chunk_fn,
+        args=args,
+        expect_donation=True,
+        with_metrics=False,
+        carry_in_argnums=(1,),
+        carry_out_index=0,
+        quantize=None if quantize == "none" else quantize,
+    )
+
+
+def fused_chunk_names() -> List[str]:
+    """The fused-round audit axis: every strategy the megakernel serves,
+    plus quantized-storage variants of one (the storage invariant is
+    strategy-independent — one spelling per mode keeps the matrix small)."""
+    from distributed_active_learning_tpu.ops.round_fused import FUSED_STRATEGIES
+
+    return sorted(FUSED_STRATEGIES) + [
+        "uncertainty-bf16", "uncertainty-int8",
+    ]
 
 
 def _build_sweep(
@@ -578,6 +646,9 @@ def build_registry(
 
     for kind, builder, names in (
         ("chunk", _build_chunk, forest_strategy_names()),
+        # the round megakernel: every strategy it serves + the quantized
+        # storage variants (the quantized-leaf-upcast rule's audit surface)
+        ("fused_chunk", _build_fused_chunk, fused_chunk_names()),
         ("sweep", _build_sweep, forest_strategy_names()),
         # one fixed heterogeneous group set: the grid program's novelty is
         # the multi-strategy merge itself, not per-strategy variants (each
@@ -642,9 +713,8 @@ def specs_for_experiment(
 
         name = neural_strategy
         if name not in FUSABLE_STRATEGIES:
-            # per-round-only strategies (batchbald/coreset/badge) have no
-            # fused program to audit; fall back to a fusable stand-in that
-            # shares the fit/predict pipeline
+            # every registered deep strategy fuses as of PR 10; this stand-in
+            # only catches a future strategy added without a fused program
             name = "entropy"
         return build_registry(
             strategies=[name],
@@ -670,23 +740,36 @@ def specs_for_experiment(
             )
         ]
     kind = "sweep" if getattr(cfg, "sweep_seeds", 1) > 1 else "chunk"
+    name = cfg.strategy.name
+    if kind == "chunk" and getattr(cfg, "fused_round", False):
+        # a --fused-round run launches the megakernel chunk; audit THAT
+        # program (including its quantized-storage spelling, so the
+        # quantized-leaf-upcast rule covers exactly what will run)
+        kind = "fused_chunk"
+        q = getattr(cfg.forest, "quantize", "none")
+        if q != "none":
+            name = f"{name}-{q}"
     if cfg.mesh.data * cfg.mesh.model <= 1:
         return build_registry(
-            strategies=[cfg.strategy.name], kinds=[kind], placements=["cpu"]
+            strategies=[name], kinds=[kind], placements=["cpu"]
         )
     shape = (cfg.mesh.data, cfg.mesh.model)
     if N_TREES % shape[1]:
         shape = MESH_SHAPE  # inexpressible model width: the 4x2 stand-in
-    builder = _build_chunk if kind == "chunk" else _build_sweep
+    builder = {
+        "chunk": _build_chunk,
+        "fused_chunk": _build_fused_chunk,
+        "sweep": _build_sweep,
+    }[kind]
     placement = f"mesh{shape[0]}x{shape[1]}"
     return [
         ProgramSpec(
-            name=f"{kind}/{cfg.strategy.name}/{placement}",
+            name=f"{kind}/{name}/{placement}",
             kind=kind,
-            strategy=cfg.strategy.name,
+            strategy=name,
             placement=placement,
             build=functools.partial(
-                builder, cfg.strategy.name, placement, mesh_shape=shape
+                builder, name, placement, mesh_shape=shape
             ),
         )
     ]

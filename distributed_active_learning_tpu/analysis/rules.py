@@ -353,5 +353,82 @@ def _rule_metrics(unit) -> Iterator[Finding]:
         )
 
 
+@register_rule(
+    "quantized-leaf-upcast",
+    "error",
+    "a program built with quantized forest storage (ForestConfig.quantize) "
+    "must keep the narrow representation live: the storage dtype present, an "
+    "in-program dequantization convert present, and (int8) the rank-<=2 leaf "
+    "tensor reaching the streaming eval eqns — a silent f32 upcast between "
+    "fit and eval forfeits the 2-4x bandwidth headroom without failing any "
+    "numeric test",
+)
+def _rule_quantized_upcast(unit) -> Iterator[Finding]:
+    mode = getattr(unit, "quantize", None)
+    if mode not in ("bf16", "int8"):
+        return
+    narrow = "int8" if mode == "int8" else "bfloat16"
+    # (1) storage exists at all: if quantize_forest stopped being applied the
+    # whole program is silently f32 again.
+    if not any(
+        str(getattr(aval, "dtype", "")) == narrow for _, aval in unit.avals
+    ):
+        yield _finding(
+            "quantized-leaf-upcast", unit, "<avals>",
+            f"quantize={mode!r} declared but no {narrow} aval exists anywhere "
+            "in the traced program — the storage was never narrowed",
+        )
+        return
+    # (2) the point-of-use dequant: some narrow -> f32 convert must exist
+    # (models.forest.dequantize_leaf_values inside the eval bodies).
+    has_dequant = any(
+        site.eqn.primitive.name == "convert_element_type"
+        and site.eqn.invars
+        and hasattr(site.eqn.invars[0], "aval")
+        and str(site.eqn.invars[0].aval.dtype) == narrow
+        and str(site.eqn.params.get("new_dtype")) == "float32"
+        for site in unit.eqn_sites
+    )
+    if not has_dequant:
+        yield _finding(
+            "quantized-leaf-upcast", unit, "<eqns>",
+            f"no {narrow} -> float32 convert in the program: the quantized "
+            "leaves are never dequantized at the point of use (either the "
+            "eval reads them raw — wrong numerics — or a cached f32 copy is "
+            "being streamed instead)",
+        )
+    if mode != "int8":
+        # bf16 mode has no sharper static signature: bf16 operands are
+        # legitimate all over the eval kernels (x tiles, path matrices), so
+        # presence + dequant is the checkable invariant.
+        return
+    # (3) int8 only: the leaf-stat tensor (rank <= 2; the pallas path matrix
+    # is the only other int8 operand and rides rank 3) must be an INPUT of a
+    # streaming eval eqn — pallas_call, or a scan nested under the chunk's
+    # outer scan (the lax.map tile stream). An upcast between fit and eval
+    # hands those eqns f32 leaves instead.
+    for site in unit.eqn_sites:
+        name = site.eqn.primitive.name
+        in_stream = name == "pallas_call" or (
+            name == "scan" and site.path.count("scan") >= 1
+        )
+        if not in_stream:
+            continue
+        for v in site.eqn.invars:
+            aval = getattr(v, "aval", None)
+            if (
+                aval is not None
+                and str(getattr(aval, "dtype", "")) == "int8"
+                and len(getattr(aval, "shape", ())) <= 2
+            ):
+                return
+    yield _finding(
+        "quantized-leaf-upcast", unit, "<eqns>",
+        "int8 leaf stats never reach a streaming eval eqn (pallas_call or "
+        "nested scan) as an input — the stored forest was upcast to f32 "
+        "between fit and eval, forfeiting the bandwidth headroom",
+    )
+
+
 def default_rules() -> List[Rule]:
     return list(RULES.values())

@@ -51,6 +51,15 @@ class ForestConfig:
     # Static node budget per tree for the packed representation. A binary tree of
     # depth D has at most 2^(D+1) - 1 nodes; loaders assert fit.
     node_budget: Optional[int] = None
+    # Quantized forest storage (ops/trees_train.py::quantize_forest): "bf16"
+    # stores thresholds + leaf stats in bfloat16, "int8" additionally rounds
+    # classifier leaf probabilities onto a fixed int8 grid — 2-4x less HBM
+    # traffic for the bandwidth-bound eval phases, dequantized at the point
+    # of use INSIDE the kernels. Device fit only (its thresholds are
+    # bf16-snapped bin edges, making bf16 threshold storage lossless —
+    # decision paths bit-identical to f32 storage; int8 leaves shift scores
+    # by <= 1/254 per probability, tests/test_round_fused.py tolerances).
+    quantize: str = "none"
     seed: int = 0
 
     @property
@@ -197,6 +206,18 @@ class ExperimentConfig:
     # to the traced chunk program, and the zero-overhead fast path must stay
     # untouched unless explicitly asked for.
     stream_round_events: bool = False
+    # Round megakernel (ops/round_fused.py): fuse forest eval -> acquisition
+    # score -> top-k selection into ONE pass over the pool slab — a pallas
+    # megakernel for kernel="pallas" (votes accumulate in VMEM, per-tile
+    # top-k on the last tree tile; neither the [pool, trees] vote matrix nor
+    # the score vector lands in HBM), an XLA lax.map stream of exact GEMM
+    # tile bodies for kernel="gemm". Bit-identical to the unfused path
+    # (tests/test_round_fused.py pins CPU + the 4x2 mesh). Opt-in and loudly
+    # validated: only the vote-fraction strategies fuse
+    # (ops.round_fused.FUSED_STRATEGIES), the fit must be on device, binary
+    # pools only, and RoundMetrics are refused (they need the full score
+    # vector the megakernel exists to avoid materializing).
+    fused_round: bool = False
     seed: int = 0
     # Observability
     # Compute per-round RoundMetrics (runtime/telemetry.py) on device and

@@ -171,6 +171,60 @@ def fit_forest_regressor(
     return pack_sklearn_forest(model, node_budget=cfg.resolved_node_budget, max_depth=cfg.max_depth)
 
 
+# --- quantized forest storage ----------------------------------------------
+# Storage formats for the round megakernel's bandwidth headroom
+# (ops/round_fused.py): thresholds ride bf16 (lossless once bin edges are
+# bf16-snapped at make_bins — quantile edges are the only threshold source on
+# the device-fit path), leaf stats ride bf16 or int8. Dequantization happens
+# at the point of use INSIDE the evaluation kernels (trees_gemm /
+# trees_pallas / round_fused) — the stored representation must never be
+# silently widened to f32 between fit and eval, which the
+# `quantized-leaf-upcast` audit rule (analysis/rules.py) pins statically.
+
+#: Fixed int8 scale for class-probability leaves: q = round(p * 127) maps
+#: [0, 1] onto [0, 127] (within int8), worst-case dequant error 1/254. Only
+#: classifier leaves (probabilities) quantize to int8; regression payloads
+#: (the LAL regressor) are unbounded and stay f32.
+INT8_LEAF_SCALE = 127.0
+
+VALID_QUANTIZE_MODES = ("none", "bf16", "int8")
+
+
+def quantize_leaf_values(value: jnp.ndarray, mode: str) -> jnp.ndarray:
+    """Quantize a leaf-probability tensor for storage.
+
+    ``"bf16"`` is a cast; ``"int8"`` rounds onto the fixed
+    :data:`INT8_LEAF_SCALE` grid (values must be probabilities in [0, 1]).
+    jit-safe: pure elementwise ops, so the device fit can quantize in-program
+    and the stored forest leaves HBM at the narrow dtype.
+    """
+    if mode == "none":
+        return value
+    if mode == "bf16":
+        return value.astype(jnp.bfloat16)
+    if mode == "int8":
+        return jnp.round(value * INT8_LEAF_SCALE).astype(jnp.int8)
+    raise ValueError(
+        f"unknown quantize mode {mode!r}; one of {VALID_QUANTIZE_MODES}"
+    )
+
+
+def dequantize_leaf_values(value: jnp.ndarray) -> jnp.ndarray:
+    """Recover f32 leaf probabilities at the point of use (in-kernel).
+
+    Dispatches on the STORED dtype, so evaluation kernels call this
+    unconditionally: f32 passes through untouched (the unquantized path's
+    traced program is unchanged), bf16 widens losslessly, int8 rescales by
+    the fixed grid. ``np.float32(1/scale)`` keeps the multiplier a weak-free
+    f32 constant (the auditor's f64 rule watches closure constants).
+    """
+    if value.dtype == jnp.int8:
+        return value.astype(jnp.float32) * np.float32(1.0 / INT8_LEAF_SCALE)
+    if value.dtype == jnp.bfloat16:
+        return value.astype(jnp.float32)
+    return value
+
+
 def forest_accuracy(forest: PackedForest, x, y) -> float:
     """Test-set accuracy of the packed forest (the reference's per-round eval,
     ``uncertainty_sampling.py:79-83``)."""

@@ -1,0 +1,426 @@
+"""The round megakernel: forest eval -> acquisition score -> streaming top-k
+in ONE pass over the pool.
+
+The unfused round runs three programs' worth of HBM traffic per round: the
+forest eval writes the ``[pool, trees]`` leaf/vote matrix, the scoring pass
+reads it back to build the ``[pool]`` score vector, and the top-k reads that
+again. All three stream the same pool slab; the PR-8 roofline verdicts put
+the score/select half bandwidth-bound. This module fuses the chain so each
+pool slab crosses VMEM ONCE per round:
+
+- **TPU (pallas forests)**: a megakernel over a ``(row tiles, tree tiles)``
+  grid — the tree loop accumulates hard votes for the resident row tile in a
+  VMEM scratch (reusing the per-tree-block eval body and tiling machinery of
+  ``ops/trees_pallas.py``), and on the LAST tree tile the kernel computes
+  the acquisition score and extracts a per-tile top-k in place. Outputs are
+  ``[row_tiles, k]`` candidates; neither the vote matrix nor the score
+  vector ever lands in HBM.
+- **CPU / gemm forests**: the same streaming formulation as XLA: a
+  ``lax.map`` over row tiles runs eval -> votes -> score -> per-tile top-k
+  with the exact GEMM tile body (``trees_gemm._predict_chunk``), so
+  per-tile intermediates stay cache-resident instead of round-tripping a
+  ``[pool, trees]`` tensor through memory.
+- **mesh (ShardedPallasForest)**: per-shard fused vote accumulation under
+  ``shard_map`` (rows over ``data``, trees over ``model``) + one psum — the
+  ``[n_local, T_local]`` leaf matrix never materializes per shard — then the
+  score + global top-k run on the psum'd ``[n]`` votes (selection still
+  funnels globally; fully-distributed selection is the pod-sharding ROADMAP
+  item).
+
+Both single-device paths emit per-tile candidates merged by
+``ops.topk.merge_tile_topk``; the merge (and the tie-break argument for its
+exactness) lives there.
+
+Bit-identity contract (pinned in tests/test_round_fused.py): with
+unquantized storage the fused round reproduces the unfused reference path
+bit-for-bit — the supported strategies all score the INTEGER vote fraction
+(``votes / n_trees``), vote sums are exact in any accumulation order, the
+score formulas are the very functions ``strategies/core.py`` applies
+(imported, not re-derived), and the selection's tie-breaking matches
+``lax.top_k``'s lowest-index rule (in-kernel: first-index argmax per pick).
+One caveat mirrors ``ops/topk.py``: if fewer than ``k`` selectable points
+remain globally, sentinel tail indices may differ from the reference's —
+both scatter as no-ops into the labeled mask. On real TPUs the entropy
+scores' transcendentals may differ in ulps between Mosaic and XLA lowerings;
+the rational-arithmetic strategies (uncertainty, margin) are exact
+everywhere, and CPU CI (interpret mode) executes identical primitives for
+all of them.
+
+Quantized storage (``ForestConfig.quantize``) rides through unchanged: the
+shared eval body dequantizes bf16 thresholds / int8 leaf stats in-kernel
+(``trees_pallas._leaf_rows``), so the 2-4x narrower forest is what streams
+through HBM.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from distributed_active_learning_tpu.ops import scoring
+from distributed_active_learning_tpu.ops import trees_pallas
+from distributed_active_learning_tpu.ops.topk import (
+    NEG_INF,
+    merge_tile_topk,
+    select_bottom_k,
+    select_top_k,
+)
+from distributed_active_learning_tpu.ops.trees_gemm import (
+    GemmForest,
+    _predict_chunk,
+    predict_leaves_gemm,
+)
+from distributed_active_learning_tpu.ops.trees_pallas import (
+    PallasForest,
+    ShardedPallasForest,
+    _BT,
+    _pad_to,
+)
+
+#: Strategies the fused round serves: every binary strategy whose score is a
+#: pure function of the hard vote fraction (scoring rules imported from the
+#: same module the unfused strategies use — one definition, zero drift).
+#: Vote counts are integers, so these are bit-identical under ANY tiling or
+#: shard reduction order. The rest fall back by construction: ``random``
+#: needs no forest pass at all, ``density``/``lal`` consume O(n^2) similarity
+#: or regressor aux inputs that are not per-tile-local, ``soft_uncertainty``
+#: scores the f32 mean leaf probability (tile-order-sensitive sums).
+FUSED_STRATEGIES: Dict[str, Tuple] = {
+    "uncertainty": (scoring.uncertainty_score, False),
+    "entropy": (scoring.positive_entropy, True),
+    "full_entropy": (scoring.full_entropy, True),
+    "margin": (scoring.margin_score, False),
+}
+
+
+def supports(strategy_name: str) -> bool:
+    return strategy_name in FUSED_STRATEGIES
+
+
+def _score_from_votes(votes_f32: jnp.ndarray, n_trees: int, strategy_name: str):
+    """Vote counts -> directed score: ``p = votes / T`` exactly as
+    ``strategies.core._vote_fraction`` divides, then the strategy's own
+    scoring function; negated for ascending strategies so every caller works
+    in one maximize space."""
+    score_fn, higher = FUSED_STRATEGIES[strategy_name]
+    p = votes_f32 / np.float32(n_trees)
+    s = score_fn(p)
+    return (s if higher else -s), higher
+
+
+# ---------------------------------------------------------------------------
+# XLA streaming formulation (gemm forests; the CPU path)
+# ---------------------------------------------------------------------------
+
+def _stream_tile(n: int) -> int:
+    """Row-tile width for the lax.map stream: 2048 keeps the [tile, T]
+    intermediates cache-resident at bench shapes; small pools shrink to one
+    power-of-two tile to bound padding."""
+    return min(2048, max(256, 1 << max(n - 1, 1).bit_length()))
+
+
+def _xla_streamed(
+    gf: GemmForest,
+    x: jnp.ndarray,
+    selectable: jnp.ndarray,
+    strategy_name: str,
+    k: int,
+):
+    """Per-tile candidates via a lax.map stream of exact GEMM tile bodies."""
+    n, d = x.shape
+    T = gf.n_trees
+    tile = _stream_tile(n)
+    pad = (-n) % tile
+    xp = jnp.pad(x, ((0, pad), (0, 0)))
+    selp = jnp.pad(selectable, (0, pad))  # padding rows unselectable
+    n_tiles = xp.shape[0] // tile
+    bases = jnp.arange(n_tiles, dtype=jnp.int32) * tile
+
+    def one_tile(args):
+        xb, sb, base = args
+        with jax.named_scope("fused_round/tile"):
+            leaves = _predict_chunk(gf, xb)  # [tile, T] — never [n, T]
+            votes = jnp.sum(leaves > 0.5, axis=1).astype(jnp.int32)
+            s, _ = _score_from_votes(
+                votes.astype(jnp.float32), T, strategy_name
+            )
+            work = jnp.where(sb, s, NEG_INF)
+            v, i = lax.top_k(work, k)
+            return v, base + i
+
+    tv, ti = lax.map(
+        one_tile, (xp.reshape(n_tiles, tile, d), selp.reshape(n_tiles, tile), bases)
+    )
+    return tv, ti
+
+
+# ---------------------------------------------------------------------------
+# the pallas megakernel (TPU; interpret mode on CPU for parity tests)
+# ---------------------------------------------------------------------------
+
+def _mega_kernel(
+    n_trees, strategy_name, k, nj, bn,
+    xT_ref, selT_ref, thr_ref, pathT_ref, tgt_ref, val_ref, pen_ref,
+    vals_ref, idx_ref, votes_ref,
+):
+    """One (row tile, tree tile) grid step.
+
+    The tree axis is the inner grid dimension: the x tile stays VMEM-resident
+    across it (its index_map ignores j), votes accumulate in the scratch, and
+    the last tree tile computes score + top-k without the row tile ever
+    leaving the chip.
+    """
+    # Both program_ids are read OUTSIDE the pl.when bodies: jax 0.4.37's
+    # interpret mode doesn't substitute pl.program_id inside a cond sub-jaxpr.
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+    rows = trees_pallas._leaf_rows(
+        xT_ref, selT_ref, thr_ref, pathT_ref, tgt_ref, val_ref
+    )
+    leaf = jnp.concatenate(rows, axis=0)  # [BT, bn] f32
+    # Hard votes; padded trees contribute leaf value 0 -> vote 0. f32
+    # accumulation is exact for counts (integers < 2^24).
+    part = jnp.sum((leaf > 0.5).astype(jnp.float32), axis=0, keepdims=True)
+
+    @pl.when(j == 0)
+    def _init():
+        votes_ref[:] = part
+
+    @pl.when(j > 0)
+    def _accumulate():
+        votes_ref[:] = votes_ref[:] + part
+
+    @pl.when(j == nj - 1)
+    def _score_and_select():
+        s, _ = _score_from_votes(votes_ref[:], n_trees, strategy_name)
+        work = s + pen_ref[:]  # -inf penalty kills labeled/padded columns
+        iota = lax.broadcasted_iota(jnp.int32, (1, bn), 1)
+        picked_v, picked_i = [], []
+        for _ in range(k):
+            m = jnp.max(work)
+            hit = work == m
+            # first-index tie-break — the lax.top_k ordering the unfused
+            # reference selection uses
+            first = jnp.min(jnp.where(hit, iota, bn))
+            picked_v.append(m)
+            picked_i.append(first)
+            work = jnp.where(iota == first, NEG_INF, work)
+        base = i * bn
+        k_pad = vals_ref.shape[1]
+        vals_row = jnp.stack(picked_v).reshape(1, k)
+        idx_row = jnp.stack(picked_i).reshape(1, k) + base
+        vals_ref[:] = jnp.pad(
+            vals_row, ((0, 0), (0, k_pad - k)), constant_values=NEG_INF
+        )
+        idx_ref[:] = jnp.pad(idx_row, ((0, 0), (0, k_pad - k))).astype(jnp.int32)
+
+
+def _megakernel(
+    gf: GemmForest,
+    x: jnp.ndarray,
+    selectable: jnp.ndarray,
+    strategy_name: str,
+    k: int,
+    interpret: bool = False,
+):
+    """Per-row-tile top-k candidates, one VMEM pass per pool slab."""
+    n, d = x.shape
+    T = gf.n_trees
+    dims = trees_pallas.tile_dims(gf, n, d)
+    if dims is None:
+        # Same fallback boundary as predict_leaves_pallas: shapes past the
+        # VMEM tiling budget stream through the exact GEMM formulation.
+        return _xla_streamed(gf, x, selectable, strategy_name, k)
+    i_pad, l_pad, d_pad, bn = dims
+    if k > bn:
+        raise ValueError(f"window {k} exceeds the row tile ({bn})")
+
+    selT, thr, pathT, tgt, val = trees_pallas.forest_operands(
+        gf, i_pad, l_pad, d_pad
+    )
+    t_pad = thr.shape[0]
+    xT = trees_pallas.x_operand(x, d_pad, bn)
+    n_pad = xT.shape[1]
+    pen = jnp.where(selectable, 0.0, NEG_INF).astype(jnp.float32)[None, :]
+    pen = _pad_to(pen, 1, bn, value=NEG_INF)
+
+    k_pad = max(-(-k // 128) * 128, 128)
+    ni, nj = n_pad // bn, t_pad // _BT
+    grid = (ni, nj)
+    kernel = functools.partial(_mega_kernel, T, strategy_name, k, nj, bn)
+    vals, idx = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((d_pad, bn), lambda i, j: (0, i)),
+            pl.BlockSpec((_BT * i_pad, d_pad), lambda i, j: (j, 0)),
+            pl.BlockSpec((_BT, i_pad), lambda i, j: (j, 0)),
+            pl.BlockSpec((_BT, l_pad, i_pad), lambda i, j: (j, 0, 0)),
+            pl.BlockSpec((_BT, l_pad), lambda i, j: (j, 0)),
+            pl.BlockSpec((_BT, l_pad), lambda i, j: (j, 0)),
+            pl.BlockSpec((1, bn), lambda i, j: (0, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, k_pad), lambda i, j: (i, 0)),
+            pl.BlockSpec((1, k_pad), lambda i, j: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((ni, k_pad), jnp.float32),
+            jax.ShapeDtypeStruct((ni, k_pad), jnp.int32),
+        ],
+        scratch_shapes=[pltpu.VMEM((1, bn), jnp.float32)],
+        interpret=interpret,
+    )(xT, selT, thr, pathT, tgt, val, pen)
+    return vals[:, :k], idx[:, :k]
+
+
+# ---------------------------------------------------------------------------
+# fused vote accumulation (the mesh per-shard body)
+# ---------------------------------------------------------------------------
+
+def _votes_kernel(
+    nj, xT_ref, selT_ref, thr_ref, pathT_ref, tgt_ref, val_ref, out_ref
+):
+    j = pl.program_id(1)
+    rows = trees_pallas._leaf_rows(
+        xT_ref, selT_ref, thr_ref, pathT_ref, tgt_ref, val_ref
+    )
+    leaf = jnp.concatenate(rows, axis=0)
+    part = jnp.sum((leaf > 0.5).astype(jnp.float32), axis=0, keepdims=True)
+
+    @pl.when(j == 0)
+    def _init():
+        out_ref[:] = part
+
+    @pl.when(j > 0)
+    def _accumulate():
+        out_ref[:] = out_ref[:] + part
+
+
+def fused_votes_pallas(
+    gf: GemmForest, x: jnp.ndarray, interpret: bool = False
+) -> jnp.ndarray:
+    """Hard vote counts ``[n] int32`` with the ``[n, T]`` leaf matrix kept in
+    VMEM (accumulated tree tile by tree tile into a revisited ``[n]`` output).
+    Falls back to the exact GEMM eval past the tiling budget — vote sums are
+    integers, so every route agrees bit-for-bit."""
+    n, d = x.shape
+    dims = trees_pallas.tile_dims(gf, n, d)
+    if dims is None:
+        return jnp.sum(predict_leaves_gemm(gf, x) > 0.5, axis=1).astype(jnp.int32)
+    i_pad, l_pad, d_pad, bn = dims
+    selT, thr, pathT, tgt, val = trees_pallas.forest_operands(
+        gf, i_pad, l_pad, d_pad
+    )
+    t_pad = thr.shape[0]
+    xT = trees_pallas.x_operand(x, d_pad, bn)
+    n_pad = xT.shape[1]
+    ni, nj = n_pad // bn, t_pad // _BT
+    out = pl.pallas_call(
+        functools.partial(_votes_kernel, nj),
+        grid=(ni, nj),
+        in_specs=[
+            pl.BlockSpec((d_pad, bn), lambda i, j: (0, i)),
+            pl.BlockSpec((_BT * i_pad, d_pad), lambda i, j: (j, 0)),
+            pl.BlockSpec((_BT, i_pad), lambda i, j: (j, 0)),
+            pl.BlockSpec((_BT, l_pad, i_pad), lambda i, j: (j, 0, 0)),
+            pl.BlockSpec((_BT, l_pad), lambda i, j: (j, 0)),
+            pl.BlockSpec((_BT, l_pad), lambda i, j: (j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bn), lambda i, j: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((1, n_pad), jnp.float32),
+        interpret=interpret,
+    )(xT, selT, thr, pathT, tgt, val)
+    return out[0, :n].astype(jnp.int32)
+
+
+def _sharded_fused_votes(f: ShardedPallasForest, x: jnp.ndarray) -> jnp.ndarray:
+    """Global vote counts ``[n]`` with rows over ``data`` and trees over
+    ``model``: each shard runs the fused vote kernel on its (row block, tree
+    shard) and one psum over ``model`` completes the reduction — the mesh
+    twin of ``parallel.kernels.sharded_votes`` minus the per-shard leaf
+    matrix."""
+    from jax.sharding import PartitionSpec as P
+
+    from distributed_active_learning_tpu.parallel import mesh as mesh_lib
+    from distributed_active_learning_tpu.parallel.collectives import (
+        vector_accumulate,
+    )
+    from distributed_active_learning_tpu.utils.compat import shard_map
+
+    n = x.shape[0]
+    x = _pad_to(x, 0, f.mesh.shape[mesh_lib.AXIS_DATA])
+    gf_specs = mesh_lib.forest_tree_specs(f.gf)
+
+    @functools.partial(
+        shard_map,
+        mesh=f.mesh,
+        in_specs=(gf_specs, P(mesh_lib.AXIS_DATA, None)),
+        out_specs=P(mesh_lib.AXIS_DATA),
+        # pallas_call declares its out_shape without varying-mesh-axes
+        # annotations (same waiver as trees_pallas._predict_leaves_sharded).
+        check_vma=False,
+    )
+    def kern(gf_local, x_blk):
+        local = fused_votes_pallas(
+            gf_local, x_blk, interpret=trees_pallas._use_interpret()
+        )
+        return vector_accumulate(local, mesh_lib.AXIS_MODEL)
+
+    return kern(f.gf, x)[:n]
+
+
+# ---------------------------------------------------------------------------
+# the dispatch
+# ---------------------------------------------------------------------------
+
+def fused_score_select(
+    forest,
+    x: jnp.ndarray,
+    selectable_mask: jnp.ndarray,
+    strategy_name: str,
+    k: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Fused eval -> score -> select: ``(vals [k], picked [k])`` with the
+    same value/index contract as ``select_top_k`` / ``select_bottom_k`` over
+    the unfused score vector (including the ascending strategies' sign
+    convention). Dispatches on the forest pytree type like the rest of
+    ``ops/forest_eval``: pallas forests take the megakernel, gemm forests
+    the XLA stream, mesh-wrapped forests the per-shard fused-votes path.
+    """
+    if strategy_name not in FUSED_STRATEGIES:
+        raise ValueError(
+            f"strategy {strategy_name!r} has no fused round; fused: "
+            f"{sorted(FUSED_STRATEGIES)}"
+        )
+    _, higher = FUSED_STRATEGIES[strategy_name]
+    with jax.named_scope("fused_round/score_select"):
+        if isinstance(forest, ShardedPallasForest):
+            votes = _sharded_fused_votes(forest, x)
+            p = votes.astype(jnp.float32) / forest.n_trees
+            scores = FUSED_STRATEGIES[strategy_name][0](p)
+            if higher:
+                return select_top_k(scores, selectable_mask, k)
+            return select_bottom_k(scores, selectable_mask, k)
+        gf = forest.gf if isinstance(forest, PallasForest) else forest
+        if not isinstance(gf, GemmForest):
+            raise TypeError(
+                "fused_score_select needs a path-matrix forest (gemm/pallas "
+                f"kernels), got {type(forest).__name__}"
+            )
+        if isinstance(forest, PallasForest):
+            tv, ti = _megakernel(
+                gf, x, selectable_mask, strategy_name, k,
+                interpret=trees_pallas._use_interpret(),
+            )
+        else:
+            tv, ti = _xla_streamed(gf, x, selectable_mask, strategy_name, k)
+        vals, idx = merge_tile_topk(tv, ti, k)
+        return (vals, idx) if higher else (-vals, idx)

@@ -45,3 +45,33 @@ def select_bottom_k(
     masked = jnp.where(unlabeled_mask, scores, POS_INF)
     vals, idx = lax.top_k(-masked, k)
     return -vals, idx
+
+
+def merge_tile_topk(
+    tile_vals: jnp.ndarray, tile_idx: jnp.ndarray, k: int
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Merge per-tile top-k candidate lists into the global top-k.
+
+    The streaming half of the fused round (``ops/round_fused.py``): each pool
+    tile contributes its own descending top-``k`` (values + pool-level
+    indices) so the full score vector never materializes in HBM; this final
+    static merge reduces the ``[tiles, k]`` candidates to the global winners.
+
+    Exactness: the global top-k is a subset of the union of per-tile top-ks
+    (any global winner is among its own tile's k best), so ``top_k`` over the
+    flattened candidates returns the same SET as ``top_k`` over the full
+    vector. Order matches too: ``lax.top_k`` breaks value ties by lowest
+    position, each tile's candidates arrive in descending order with
+    within-tile ties already in ascending index order, and tiles are
+    concatenated in ascending base-index order — so the position order of the
+    flattened candidates agrees with the index order of the full vector
+    wherever values tie. (The one divergence: if fewer than ``k`` finite
+    candidates exist globally, the sentinel tail's indices are per-tile
+    placeholders rather than the full vector's first masked positions —
+    callers scatter picks into an already-labeled mask, where those are
+    no-ops either way, matching :func:`select_top_k`'s tail contract.)
+    """
+    flat_vals = tile_vals.reshape(-1)
+    flat_idx = tile_idx.reshape(-1)
+    vals, pos = lax.top_k(flat_vals, k)
+    return vals, flat_idx[pos]

@@ -145,9 +145,15 @@ def gemm_forest_from_packed(
 
 def _predict_chunk(gf: GemmForest, x: jnp.ndarray) -> jnp.ndarray:
     """Leaf values for one pool chunk: [chunk, d] -> [chunk, T]."""
+    from distributed_active_learning_tpu.models.forest import dequantize_leaf_values
+
     T, I = gf.feat_ids.shape
     feat_vals = jnp.take(x, gf.feat_ids.reshape(-1), axis=1)  # [chunk, T*I]
-    c = (feat_vals <= gf.thresholds.reshape(-1)).astype(jnp.bfloat16)
+    # Quantized storage keeps thresholds bf16 (bf16-snapped bin edges, so the
+    # widening compare below is lossless); the f32-vs-bf16 promotion is exact.
+    c = (feat_vals <= gf.thresholds.reshape(-1).astype(jnp.float32)).astype(
+        jnp.bfloat16
+    )
     c = c.reshape(-1, T, I)
     # Batched GEMM over trees; counts are small ints — exact in bf16.
     s = jnp.einsum("nti,til->ntl", c, gf.path.astype(jnp.bfloat16))
@@ -155,8 +161,11 @@ def _predict_chunk(gf: GemmForest, x: jnp.ndarray) -> jnp.ndarray:
     hit = (s.astype(jnp.float32) == gf.target[None]).astype(jnp.float32)
     # Leaf payloads are arbitrary f32 probabilities — keep this contraction in
     # full precision so GEMM and gather kernels agree bit-for-bit on votes.
+    # Quantized (bf16/int8) leaf stats dequantize HERE, at the point of use —
+    # never between fit and storage (the quantized-leaf-upcast audit rule).
     pred = jnp.einsum(
-        "ntl,tl->nt", hit, gf.value, precision=lax.Precision.HIGHEST
+        "ntl,tl->nt", hit, dequantize_leaf_values(gf.value),
+        precision=lax.Precision.HIGHEST,
     )
     return pred
 

@@ -160,7 +160,20 @@ def _pad_to(a: jnp.ndarray, axis: int, mult: int, value=0) -> jnp.ndarray:
     return jnp.pad(a, widths, constant_values=value)
 
 
-def _kernel(xT_ref, selT_ref, thr_ref, pathT_ref, tgt_ref, val_ref, out_ref):
+def _leaf_rows(xT_ref, selT_ref, thr_ref, pathT_ref, tgt_ref, val_ref):
+    """Per-tree leaf-value rows ``[bt, BN]`` for one (row, tree) tile — the
+    shared eval body of the plain kernel and the fused-round megakernel
+    (``ops/round_fused.py`` closes over this so the two cannot drift).
+
+    Quantized storage dequantizes HERE, inside the kernel: bf16 thresholds
+    widen before the compare (lossless — they are bf16-snapped bin edges)
+    and int8/bf16 leaf stats rescale right before the leaf matvec, so the
+    narrow representation is what streams through HBM/VMEM.
+    """
+    from distributed_active_learning_tpu.models.forest import (
+        dequantize_leaf_values,
+    )
+
     bt, i_pad = thr_ref.shape
     l_pad = pathT_ref.shape[1]
     # One selection matmul covers the tree block: [BT*I, d_pad] x [d_pad, BN]
@@ -173,39 +186,50 @@ def _kernel(xT_ref, selT_ref, thr_ref, pathT_ref, tgt_ref, val_ref, out_ref):
     for t in range(bt):
         fvT = fv_all[t * i_pad:(t + 1) * i_pad]
         # bf16 [N,1]-broadcast compares crash Mosaic; compare in f32.
-        cT = (fvT.astype(jnp.float32) <= thr_ref[t][:, None]).astype(jnp.int8)
+        thr_t = thr_ref[t][:, None].astype(jnp.float32)
+        cT = (fvT.astype(jnp.float32) <= thr_t).astype(jnp.int8)
         # Ancestor-agreement counts: int8 x int8 -> int32, exact and 2x the
         # bf16 MXU rate.
         sT = jnp.dot(pathT_ref[t], cT, preferred_element_type=jnp.int32)
         # Exactly one hit per column (the reached leaf).
         hit = (sT.astype(jnp.float32) == tgt_ref[t][:, None]).astype(
             jnp.float32)
-        # Leaf gather as a full-lane f32 matvec row: exact payload.
-        rows.append(jnp.dot(val_ref[t].reshape(1, l_pad), hit,
-                            preferred_element_type=jnp.float32))
-    out_ref[:] = jnp.concatenate(rows, axis=0)
+        # Leaf gather as a full-lane f32 matvec row: exact payload (int8
+        # stats rescale onto their fixed grid first).
+        val_t = dequantize_leaf_values(val_ref[t]).reshape(1, l_pad)
+        rows.append(jnp.dot(val_t, hit, preferred_element_type=jnp.float32))
+    return rows
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def predict_leaves_pallas(
-    gf: GemmForest, x: jnp.ndarray, interpret: bool = False
-) -> jnp.ndarray:
-    """Per-tree leaf values ``[n, T]`` via the fused VMEM-resident kernel.
+def _kernel(xT_ref, selT_ref, thr_ref, pathT_ref, tgt_ref, val_ref, out_ref):
+    out_ref[:] = jnp.concatenate(
+        _leaf_rows(xT_ref, selT_ref, thr_ref, pathT_ref, tgt_ref, val_ref),
+        axis=0,
+    )
 
-    Falls back to the exact GEMM kernel when the forest/feature shapes exceed
-    the kernel's VMEM tiling budget (depth > 8 or d_pad > 512).
-    """
-    n, d = x.shape
+
+def tile_dims(gf: GemmForest, n: int, d: int):
+    """The kernel's padded tile dimensions ``(i_pad, l_pad, d_pad, bn)``, or
+    ``None`` when the shapes exceed the VMEM tiling budget (depth > 8 or
+    d_pad > 512) and callers must fall back to the exact GEMM kernel. Shared
+    with the fused-round megakernel (``ops/round_fused.py``) so both kernels
+    tile — and fall back — identically."""
     T, I = gf.feat_ids.shape
     L = gf.value.shape[1]
-
     i_pad = max(-(-I // 128) * 128, 128)
     l_pad = max(-(-L // 128) * 128, 128)
     d_pad = max(-(-d // 128) * 128, 128)
     if i_pad > _MAX_I_PAD or d_pad > _MAX_D_PAD:
-        return predict_leaves_gemm(gf, x)
+        return None
     bn = 2048 if n >= 1536 else 512
+    return i_pad, l_pad, d_pad, bn
 
+
+def forest_operands(gf: GemmForest, i_pad: int, l_pad: int, d_pad: int):
+    """Pad + transpose the forest arrays into the kernel's tree-major operand
+    layout: ``(selT, thr, pathT, tgt, val)`` with the tree axis padded to a
+    multiple of the ``_BT`` tree block. Quantized forests keep their storage
+    dtypes here (thr bf16 / val int8|bf16) — dequantization is in-kernel."""
     feat = _pad_to(gf.feat_ids, 1, i_pad)  # padded slots select feature 0...
     thr = _pad_to(gf.thresholds, 1, i_pad, value=-np.inf)  # ...compare False
     path = _pad_to(_pad_to(gf.path, 1, i_pad), 2, l_pad)
@@ -219,11 +243,38 @@ def predict_leaves_pallas(
     path = _pad_to(path, 0, _BT)
     tgt = _pad_to(tgt, 0, _BT, value=1.0e6)
     val = _pad_to(val, 0, _BT)
-    t_pad = thr.shape[0]
 
     selT = jax.nn.one_hot(feat.reshape(-1), d_pad, dtype=jnp.bfloat16)
     pathT = jnp.swapaxes(path, 1, 2).astype(jnp.int8)
-    xT = _pad_to(_pad_to(x.astype(jnp.bfloat16), 1, d_pad), 0, bn).T
+    return selT, thr, pathT, tgt, val
+
+
+def x_operand(x: jnp.ndarray, d_pad: int, bn: int) -> jnp.ndarray:
+    """The transposed ``[d_pad, n_pad]`` bf16 pool operand (row-block
+    padded)."""
+    return _pad_to(_pad_to(x.astype(jnp.bfloat16), 1, d_pad), 0, bn).T
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def predict_leaves_pallas(
+    gf: GemmForest, x: jnp.ndarray, interpret: bool = False
+) -> jnp.ndarray:
+    """Per-tree leaf values ``[n, T]`` via the fused VMEM-resident kernel.
+
+    Falls back to the exact GEMM kernel when the forest/feature shapes exceed
+    the kernel's VMEM tiling budget (depth > 8 or d_pad > 512).
+    """
+    n, d = x.shape
+    T, I = gf.feat_ids.shape
+
+    dims = tile_dims(gf, n, d)
+    if dims is None:
+        return predict_leaves_gemm(gf, x)
+    i_pad, l_pad, d_pad, bn = dims
+
+    selT, thr, pathT, tgt, val = forest_operands(gf, i_pad, l_pad, d_pad)
+    t_pad = thr.shape[0]
+    xT = x_operand(x, d_pad, bn)
     n_pad = xT.shape[1]
 
     grid = (n_pad // bn, t_pad // _BT)

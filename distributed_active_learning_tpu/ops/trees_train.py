@@ -79,12 +79,25 @@ class BinnedPool:
         return self.edges.shape[1] + 1
 
 
-def make_bins(x: jnp.ndarray, n_bins: int = 32) -> BinnedPool:
+def make_bins(
+    x: jnp.ndarray, n_bins: int = 32, quantize: str = "none"
+) -> BinnedPool:
     """Quantile-bin the pool once per experiment (MLlib finds its candidate
-    splits the same way, on a sample of the input)."""
+    splits the same way, on a sample of the input).
+
+    ``quantize != "none"`` snaps the edges onto the bf16 grid BEFORE codes
+    are computed: trained thresholds are always bin edges (``edges[bf, bb]``
+    in :func:`fit_forest_device`), so snapping here makes bf16 threshold
+    storage exactly lossless — the quantized forest's decision paths are
+    bit-identical to f32 storage of the same fitted forest by construction
+    (``code <= b  <=>  x <= edges[b]`` holds for whatever edge values are
+    used consistently between binning and inference).
+    """
     x = jnp.asarray(x, jnp.float32)
     qs = jnp.linspace(0.0, 1.0, n_bins + 1)[1:-1]  # interior quantiles
     edges = jnp.quantile(x, qs, axis=0).T  # [d, n_bins-1]
+    if quantize != "none":
+        edges = edges.astype(jnp.bfloat16).astype(jnp.float32)
     codes = code_features(x, edges)
     return BinnedPool(edges=edges, codes=codes)
 
@@ -428,6 +441,59 @@ def heap_gemm_forest(
         path=jnp.broadcast_to(jnp.asarray(path_np), (T, I, L)),
         target=jnp.broadcast_to(jnp.asarray(target_np), (T, L)),
         value=leaf_value.astype(jnp.float32),
+    )
+
+
+def quantize_forest(forest, mode: str):
+    """Quantize a fitted forest's storage (thresholds + leaf stats) in-place
+    in the pytree sense: ``bf16`` stores thresholds and leaves in bfloat16,
+    ``int8`` stores thresholds bf16 and leaf probabilities on the fixed
+    int8 grid (``models.forest.INT8_LEAF_SCALE``). Dequantization happens at
+    the point of use inside the evaluation kernels (trees_gemm /
+    trees_pallas / round_fused) — 2-4x memory-bandwidth headroom for the
+    bandwidth-bound phases the PR-8 roofline names, with zero extra HBM
+    round-trips.
+
+    jit-friendly (pure casts/rounds), so the device fit quantizes inside its
+    own program and the stored forest leaves the fit at the narrow dtypes —
+    which the ``quantized-leaf-upcast`` audit rule checks statically.
+
+    Only path-matrix forms quantize (``GemmForest``, plus its pallas/multi
+    wrappers); thresholds must be bf16-snapped bin edges (``make_bins``
+    ``quantize != "none"``) for bf16 storage to be lossless.
+    """
+    from distributed_active_learning_tpu.models.forest import (
+        VALID_QUANTIZE_MODES,
+        quantize_leaf_values,
+    )
+
+    if mode not in VALID_QUANTIZE_MODES:
+        raise ValueError(
+            f"unknown quantize mode {mode!r}; one of {VALID_QUANTIZE_MODES}"
+        )
+    if mode == "none":
+        return forest
+    from distributed_active_learning_tpu.ops.trees_multi import MultiForest
+    from distributed_active_learning_tpu.ops.trees_pallas import PallasForest
+
+    if isinstance(forest, MultiForest):
+        return MultiForest(
+            planes=tuple(quantize_forest(p, mode) for p in forest.planes)
+        )
+    if isinstance(forest, PallasForest):
+        return PallasForest(gf=quantize_forest(forest.gf, mode))
+    if not isinstance(forest, GemmForest):
+        raise ValueError(
+            "quantized storage applies to the path-matrix (gemm/pallas) "
+            f"forms only, got {type(forest).__name__}; use kernel='gemm' or "
+            "'pallas' with a depth within the path-matrix budget"
+        )
+    return GemmForest(
+        feat_ids=forest.feat_ids,
+        thresholds=forest.thresholds.astype(jnp.bfloat16),
+        path=forest.path,
+        target=forest.target,
+        value=quantize_leaf_values(forest.value, mode),
     )
 
 

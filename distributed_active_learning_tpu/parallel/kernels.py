@@ -138,6 +138,7 @@ def make_sharded_round_fn(
     mesh: Mesh,
     with_metrics: bool = False,
     n_classes: int = 2,
+    fused: bool = False,
 ):
     """The full AL round over a device mesh (GSPMD style).
 
@@ -154,7 +155,8 @@ def make_sharded_round_fn(
     from distributed_active_learning_tpu.runtime.loop import make_round_fn
 
     round_fn = make_round_fn(
-        strategy, window_size, with_metrics=with_metrics, n_classes=n_classes
+        strategy, window_size, with_metrics=with_metrics, n_classes=n_classes,
+        fused=fused,
     )
 
     def sharded_round(forest: PackedForest, state: PoolState, aux: StrategyAux):

@@ -76,6 +76,24 @@ def build_parser() -> argparse.ArgumentParser:
         "feature compares), gather (traversal form)",
     )
     ap.add_argument(
+        "--fused-round", action="store_true",
+        help="route score + select through the round megakernel "
+        "(ops/round_fused.py): forest eval, acquisition score, and top-k in "
+        "ONE pass over the pool slab — a pallas megakernel under --kernel "
+        "pallas, an XLA tile stream under --kernel gemm. Bit-identical "
+        "picks; needs --fit device, a vote-fraction strategy (uncertainty/"
+        "entropy/full_entropy/margin), a binary pool, and no --metrics-out "
+        "(refused loudly otherwise)",
+    )
+    ap.add_argument(
+        "--quantize", choices=["none", "bf16", "int8"], default="none",
+        help="quantized forest storage (device fit only): bf16 thresholds + "
+        "bf16/int8 leaf stats, dequantized inside the eval kernels — 2-4x "
+        "less HBM traffic. bf16 decision paths are bit-identical (thresholds "
+        "are bf16-snapped bin edges); int8 shifts leaf probabilities by "
+        "<= 1/254",
+    )
+    ap.add_argument(
         "--fit", choices=["host", "device"], default="host",
         help="forest training: host (sklearn on the labeled subset, the "
         "JVM-fit equivalent) or device (jitted histogram trainer; the whole "
@@ -305,6 +323,23 @@ def main(argv=None) -> int:
             "--strategies / --datasets; per-round events still arrive at "
             "every chunk touchdown via --metrics-out"
         )
+    if args.fused_round and (
+        args.sweep_seeds > 1 or args.strategies or args.datasets
+        or args.neural or args.strategy.startswith("deep.")
+    ):
+        # The megakernel is wired into the single forest chunk only
+        # (loop.make_chunk_fn); the sweep/grid/neural launchers never read
+        # cfg.fused_round, so honor the loud-refusal contract
+        # (loop._fused_round_reason) instead of silently running unfused —
+        # and note the neural loop already fuses every built-in strategy
+        # into its scan without this flag.
+        ap.error(
+            "--fused-round serves the single forest experiment only; the "
+            "sweep/grid launchers (--sweep-seeds > 1 / --strategies / "
+            "--datasets) and the neural loop run their own fused chunks "
+            "without it (ROADMAP: serving the megakernel from the batched "
+            "launchers is a follow-up)"
+        )
     # The neural (deep-AL) loop runs only when asked for explicitly: via
     # --neural or a namespaced "deep.*" strategy name. Names living in both
     # registries (e.g. "entropy") default to the classic forest path, which is
@@ -316,18 +351,10 @@ def main(argv=None) -> int:
                 "the neural path batches the seed axis only (--sweep-seeds)"
             )
         if args.sweep_seeds > 1:
-            from distributed_active_learning_tpu.runtime.neural_loop import (
-                FUSABLE_STRATEGIES,
-                _normalize_deep_name,
-            )
-
-            if _normalize_deep_name(args.strategy) not in FUSABLE_STRATEGIES:
-                ap.error(
-                    f"--sweep-seeds batches the fusable deep strategies "
-                    f"({', '.join(sorted(FUSABLE_STRATEGIES))}); "
-                    f"{args.strategy!r} unrolls a greedy per-round selection "
-                    "— loop over --seed instead"
-                )
+            # Every deep strategy batches since PR 10 folded the greedy
+            # selects (batchbald/coreset/badge) into the scanned chunk; the
+            # one remaining sweep restriction is checkpointing (one file per
+            # seed needs the grid format, a named ROADMAP follow-up).
             if args.checkpoint_dir:
                 ap.error(
                     "checkpointing is not supported by the batched neural "
@@ -426,7 +453,8 @@ def main(argv=None) -> int:
             seed=args.seed,
         ),
         forest=ForestConfig(
-            n_trees=args.trees, max_depth=args.depth, kernel=args.kernel, fit=args.fit
+            n_trees=args.trees, max_depth=args.depth, kernel=args.kernel,
+            fit=args.fit, quantize=args.quantize,
         ),
         strategy=StrategyConfig(
             name=grid_strategies[0] if grid_strategies else args.strategy,
@@ -442,6 +470,7 @@ def main(argv=None) -> int:
         pipeline_depth=args.pipeline_depth,
         sweep_seeds=args.sweep_seeds,
         stream_round_events=args.stream_rounds,
+        fused_round=args.fused_round,
         roofline=args.roofline,
         seed=args.seed,
         results_path=None,  # _emit handles --out for both loop kinds

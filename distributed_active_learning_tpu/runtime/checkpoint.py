@@ -72,6 +72,12 @@ def _forest_ident(cfg, with_mesh: bool) -> dict:
     # (ops/trees_pallas.py numerics note). Kept out of the identity because
     # refusing the resume outright would also refuse the exact cases.
     forest_ident.pop("kernel", None)
+    # Unquantized storage ("none", the default) stays out of the identity so
+    # checkpoints written before the field existed keep their fingerprint;
+    # int8/bf16 storage changes votes (int8) or at least the stored forest
+    # and participates.
+    if forest_ident.get("quantize", "none") == "none":
+        forest_ident.pop("quantize", None)
     ident = {
         "data": dataclasses.asdict(cfg.data),
         "forest": forest_ident,

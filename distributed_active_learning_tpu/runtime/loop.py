@@ -45,6 +45,7 @@ def _round_core(
     state: state_lib.PoolState,
     aux: StrategyAux,
     window=None,
+    fused: bool = False,
 ):
     """The AL round body shared by the plain and padded round functions.
 
@@ -61,17 +62,33 @@ def _round_core(
     """
     key, k_score = jax.random.split(state.key)
     state = state.replace(key=key)
-    with jax.named_scope("al/score"):
-        scores = strategy.score(forest, state, k_score, aux)
     # unlabeled_mask (not ~labeled_mask): streaming slab pools additionally
     # exclude allocated-but-unfilled rows past the dynamic fill watermark;
     # for batch pools (n_filled is None) this is the same expression.
     unlabeled = state.unlabeled_mask
-    with jax.named_scope("al/select"):
-        if strategy.higher_is_better:
-            vals, picked = select_top_k(scores, unlabeled, window_size)
-        else:
-            vals, picked = select_bottom_k(scores, unlabeled, window_size)
+    if fused:
+        # Round megakernel (ops/round_fused.py): eval -> score -> top-k in
+        # one pass over the pool slab; same (vals, picked) contract as the
+        # select_* calls below, bit-identical on CPU and the mesh. The key
+        # split above still happens so the carried PRNG stream matches the
+        # unfused round exactly. No score vector exists to return (that is
+        # the point) — callers all discard it, and metrics are validated
+        # off at config time (_fused_round_reason).
+        from distributed_active_learning_tpu.ops import round_fused
+
+        with jax.named_scope("al/fused_round"):
+            vals, picked = round_fused.fused_score_select(
+                forest, state.x, unlabeled, strategy.name, window_size
+            )
+        scores = None
+    else:
+        with jax.named_scope("al/score"):
+            scores = strategy.score(forest, state, k_score, aux)
+        with jax.named_scope("al/select"):
+            if strategy.higher_is_better:
+                vals, picked = select_top_k(scores, unlabeled, window_size)
+            else:
+                vals, picked = select_bottom_k(scores, unlabeled, window_size)
     if window is None:
         with jax.named_scope("al/reveal"):
             new_state = state_lib.reveal(state, picked)
@@ -101,6 +118,7 @@ def make_round_fn(
     window_size: int,
     with_metrics: bool = False,
     n_classes: int = 2,
+    fused: bool = False,
 ):
     """Build the jitted AL round: score pool -> masked top-k -> reveal.
 
@@ -111,14 +129,28 @@ def make_round_fn(
     histogram, labeled fraction) and returns it as a fourth output — both
     drivers (per-round and scan-fused) then run the SAME metrics program, so
     their metrics agree bit-for-bit like their accuracies do.
+
+    ``fused`` routes score + select through the round megakernel
+    (``ops/round_fused.py``) — one pass over the pool slab, bit-identical
+    picks, ``scores`` output replaced by ``None``. Mutually exclusive with
+    ``with_metrics`` (the metrics reductions need the score vector the fused
+    round never materializes); callers validate via
+    :func:`_fused_round_reason` before asking.
     """
+    if fused and with_metrics:
+        raise ValueError(
+            "fused_round cannot compute RoundMetrics: the metrics reductions "
+            "consume the full score vector the megakernel avoids "
+            "materializing — drop collect_metrics/--metrics-out or fused_round"
+        )
 
     @jax.jit
     def round_fn(
         forest: forest_eval.Forest, state: state_lib.PoolState, aux: StrategyAux
     ):
         return _round_core(
-            strategy, window_size, with_metrics, n_classes, forest, state, aux
+            strategy, window_size, with_metrics, n_classes, forest, state, aux,
+            fused=fused,
         )
 
     return round_fn
@@ -153,6 +185,89 @@ def make_padded_round_fn(
         )
 
     return round_fn
+
+
+def _fused_round_reason(
+    cfg: ExperimentConfig, want_metrics: bool, n_classes: int
+) -> Optional[str]:
+    """Why this config cannot take the round megakernel (None = it can).
+
+    ``fused_round`` is an opt-in perf flag, so an unservable combination is
+    REFUSED with the named reason rather than silently falling back — the
+    user asked for one HBM pass per round and must know they did not get it.
+    """
+    from distributed_active_learning_tpu.ops import round_fused
+
+    if not round_fused.supports(cfg.strategy.name):
+        return (
+            f"strategy {cfg.strategy.name!r} is not a pure vote-fraction "
+            f"score; fused: {sorted(round_fused.FUSED_STRATEGIES)}"
+        )
+    if cfg.forest.fit != "device":
+        return "host fit re-enters the host every round; use --fit device"
+    if cfg.forest.kernel not in ("gemm", "pallas"):
+        return (
+            f"kernel {cfg.forest.kernel!r} has no fused round; use 'gemm' "
+            "(XLA stream) or 'pallas' (megakernel)"
+        )
+    if cfg.forest.max_depth > forest_eval._GEMM_MAX_DEPTH:
+        return (
+            f"max_depth {cfg.forest.max_depth} exceeds the path-matrix "
+            f"budget ({forest_eval._GEMM_MAX_DEPTH}); the fit would emit a "
+            "gather-form forest the fused round cannot evaluate"
+        )
+    if n_classes > 2:
+        return "fused round scores binary vote fractions; pool is multiclass"
+    if cfg.strategy.window_size > 2048:
+        # Both fused paths keep a per-tile top-k no wider than the row tile
+        # (gemm stream tiles cap at 2048, round_fused._stream_tile; the
+        # pallas megakernel's row tile is narrower still) — name the limit
+        # here instead of surfacing lax.top_k's k-vs-axis error mid-trace.
+        return (
+            f"window {cfg.strategy.window_size} exceeds the fused per-tile "
+            "top-k width (2048); the streaming merge keeps k candidates "
+            "per row tile"
+        )
+    if want_metrics:
+        return (
+            "RoundMetrics consume the full score vector the megakernel "
+            "avoids materializing; drop --metrics-out/collect_metrics"
+        )
+    return None
+
+
+def _validate_quantize(cfg: ExperimentConfig) -> None:
+    """Quantized storage needs the device fit (bf16-snapped bin-edge
+    thresholds are what make bf16 storage lossless) and a path-matrix
+    kernel form (the dequantizing eval bodies live in trees_gemm /
+    trees_pallas / round_fused)."""
+    from distributed_active_learning_tpu.models.forest import VALID_QUANTIZE_MODES
+
+    q = cfg.forest.quantize
+    if q not in VALID_QUANTIZE_MODES:
+        raise ValueError(
+            f"unknown ForestConfig.quantize {q!r}; one of {VALID_QUANTIZE_MODES}"
+        )
+    if q == "none":
+        return
+    if cfg.forest.fit != "device":
+        raise ValueError(
+            "quantized forest storage requires the device fit (host-fit "
+            "sklearn midpoints are not bf16-snapped bin edges, so bf16 "
+            "threshold storage would silently move decision boundaries); "
+            "use --fit device or quantize='none'"
+        )
+    if cfg.forest.kernel not in ("gemm", "pallas"):
+        raise ValueError(
+            f"quantized storage applies to the path-matrix kernels, not "
+            f"{cfg.forest.kernel!r}; use kernel='gemm' or 'pallas'"
+        )
+    if cfg.forest.max_depth > forest_eval._GEMM_MAX_DEPTH:
+        raise ValueError(
+            f"max_depth {cfg.forest.max_depth} exceeds the path-matrix "
+            f"budget ({forest_eval._GEMM_MAX_DEPTH}); quantized storage "
+            "has no gather-form dequantizer"
+        )
 
 
 @jax.jit
@@ -272,7 +387,18 @@ def _device_fit_core(cfg: ExperimentConfig, budget: int, n_classes: int):
             )
             if to_gemm:
                 gf = trees_train.heap_gemm_forest(f, th, v, fc.max_depth)
+                if fc.quantize != "none":
+                    # Storage narrows INSIDE the fit program, so the forest
+                    # leaves the launch at the narrow dtypes — what the
+                    # quantized-leaf-upcast audit rule pins statically.
+                    gf = trees_train.quantize_forest(gf, fc.quantize)
                 return _wrap_pallas(gf) if fc.kernel == "pallas" else gf
+            if fc.quantize != "none":
+                raise ValueError(
+                    "quantized storage needs the path-matrix (gemm/pallas) "
+                    f"form; depth {fc.max_depth} fits emit packed forests "
+                    "(see runtime.loop._validate_quantize)"
+                )
             return trees_train.heap_packed_forest(f, th, v, fc.max_depth)
 
     return fit_body
@@ -327,6 +453,7 @@ def make_chunk_fn(
     n_classes: int = 2,
     donate: bool = True,
     stream_cb=None,
+    fused_round: bool = False,
 ):
     """Fuse ``chunk_size`` AL rounds into ONE jitted ``lax.scan`` program.
 
@@ -386,7 +513,8 @@ def make_chunk_fn(
     first launch for exactly this reason.
     """
     round_fn = make_round_fn(
-        strategy, window_size, with_metrics=with_metrics, n_classes=n_classes
+        strategy, window_size, with_metrics=with_metrics, n_classes=n_classes,
+        fused=fused_round,
     )
 
     @functools.partial(jax.jit, donate_argnums=(1,) if donate else ())
@@ -515,6 +643,12 @@ def run_experiment(
 
     strategy = get_strategy(cfg.strategy)
 
+    _validate_quantize(cfg)
+    if cfg.fused_round:
+        reason = _fused_round_reason(cfg, want_metrics, n_classes)
+        if reason is not None:
+            raise ValueError(f"fused_round unavailable: {reason}")
+
     # Distribution: when the config names a >1-device mesh, pad the pool to
     # data-axis divisibility, place state/forest shardings, and let GSPMD
     # compile the same round function into one SPMD program (the replacement
@@ -540,6 +674,7 @@ def run_experiment(
         round_fn = make_sharded_round_fn(
             strategy, cfg.strategy.window_size, mesh,
             with_metrics=want_metrics, n_classes=n_classes,
+            fused=cfg.fused_round,
         )
         if cfg.forest.kernel == "pallas":
             # pallas_call has no GSPMD partitioning rule, so the fused kernel
@@ -558,6 +693,7 @@ def run_experiment(
         round_fn = make_round_fn(
             strategy, cfg.strategy.window_size,
             with_metrics=want_metrics, n_classes=n_classes,
+            fused=cfg.fused_round,
         )
         place_forest = lambda f: f
 
@@ -606,7 +742,10 @@ def run_experiment(
     if cfg.forest.fit == "device":
         from distributed_active_learning_tpu.ops import trees_train
 
-        binned = trees_train.make_bins(jnp.asarray(host_x), cfg.forest.max_bins)
+        binned = trees_train.make_bins(
+            jnp.asarray(host_x), cfg.forest.max_bins,
+            quantize=cfg.forest.quantize,
+        )
         codes = binned.codes
         if state.n_pool > codes.shape[0]:  # align with mesh padding rows
             codes = jnp.pad(codes, ((0, state.n_pool - codes.shape[0]), (0, 0)))
@@ -669,6 +808,7 @@ def run_experiment(
             with_metrics=want_metrics,
             n_classes=n_classes,
             stream_cb=stream_cb,
+            fused_round=cfg.fused_round,
         )
         # The chunk donates the carried state's buffers; at round 0
         # aux.seed_mask aliases state.labeled_mask, and a donated alias would

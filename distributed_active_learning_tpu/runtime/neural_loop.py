@@ -86,10 +86,10 @@ class NeuralExperimentConfig:
     # launch, exactly like the forest loop's knob of the same name: the carry
     # is (net TrainState, PoolState, loop key), stopping stays exact via
     # masked in-scan no-ops, and results are bit-identical to the per-round
-    # loop (tests/test_pipeline.py). Engages for the in-scan-fusable
-    # strategies (the MC-score family + random + density); batchbald/coreset/
-    # badge unroll their greedy selection k times per round and fall back to
-    # the per-round loop rather than paying a k*K-times-unrolled compile.
+    # loop (tests/test_pipeline.py). Every deep strategy engages: the greedy
+    # batch selects (batchbald/coreset/badge) unroll window_size times inside
+    # the scan BODY, which is traced once regardless of K — the same compile
+    # cost their standalone jitted selects already paid per round.
     rounds_per_launch: int = 1
     # Chunk launches in flight at once (runtime/pipeline.py; 1 = strict
     # serial launch -> block -> touchdown). Performance-only.
@@ -174,12 +174,21 @@ def _place_on_mesh(cfg: MeshConfig, state, pool_x, net_state):
     return mesh, state, pool_x, net_state
 
 
-#: Deep strategies whose acquire program fuses into the scanned chunk: the
-#: MC-score family plus random and density are a fixed pipeline of
-#: predict/score/top-k ops. batchbald/coreset/badge unroll a greedy selection
-#: ``window_size`` times per round — inside a K-round scan that is a k*K-fold
-#: unroll, so they keep the per-round loop instead.
-FUSABLE_STRATEGIES = frozenset(_SCORES) | {"random", "density"}
+#: Deep strategies whose acquire program fuses into the scanned chunk: ALL of
+#: them. The MC-score family plus random and density are a fixed pipeline of
+#: predict/score/top-k ops; batchbald/coreset/badge unroll their greedy
+#: selection ``window_size`` times — but a ``lax.scan`` body is traced ONCE,
+#: so inside a K-round chunk the compile cost is the same k-fold unroll their
+#: standalone jitted selects already pay (NOT k*K, the misreading that kept
+#: them on the per-round loop until PR 10). The paper's strongest batch
+#: baselines (BatchBALD — Kirsch et al. 2019; coreset k-Center-Greedy —
+#: Sener & Savarese 2018; BADGE — Ash et al. 2020) therefore no longer drop
+#: out of fused dispatch.
+FUSABLE_STRATEGIES = frozenset(_deep_names())
+
+#: Default (max_configs, candidate_pool, mc_samples) for the in-scan
+#: BatchBALD select — the NeuralExperimentConfig defaults.
+_BATCHBALD_DEFAULTS = (4096, 512, 256)
 
 
 def _make_neural_round_core(
@@ -189,13 +198,33 @@ def _make_neural_round_core(
     beta: float,
     with_metrics: bool,
     n_classes: int,
+    coreset_space: str = "input",
+    batchbald_params=_BATCHBALD_DEFAULTS,
 ):
     """The fit → MC-score → select → reveal → accuracy body shared by the
     serial chunk and the seed-sweep lane (vmapped there), factored out so the
     two entry points cannot drift — the neural twin of
     ``runtime.loop._device_fit_core``. Returns ``(net, new_st, acc, picked,
     metrics-or-None)``; the callers own the key split, the active/no-op cond,
-    and the ys layout."""
+    and the ys layout.
+
+    The per-round PRNG protocol matches ``run_neural_experiment``'s fallback
+    loop branch-for-branch: MC samples always draw from ``k_mc``, the
+    selection randomness (random's uniform, badge's k-means++ draws,
+    batchbald's MC-config draws) from ``k_rand`` — so fused and per-round
+    curves agree bit-for-bit for every strategy.
+
+    The greedy strategies' RoundMetrics score vectors are per-point proxies
+    (their selection values are inherently batch-sequential): coreset uses
+    distance-to-nearest-center (``deep.coreset_min_dists`` — exactly its own
+    greedy init, so XLA CSEs the duplicate), badge the hallucinated-gradient
+    embedding norm ``|g_i ⊗ h_i|²``, batchbald the marginal BALD score.
+    """
+    if coreset_space not in ("input", "embedding"):
+        raise ValueError(
+            f"unknown coreset_space {coreset_space!r}; use 'input' or "
+            "'embedding'"
+        )
 
     def round_core(st, net_in, pool_x, test_x, test_y, k_fit, k_mc, k_rand):
         fit_mask = st.labeled_mask
@@ -205,7 +234,9 @@ def _make_neural_round_core(
 
         unlabeled = ~st.labeled_mask
         probs = None
-        if strat != "random" or with_metrics:
+        # random/coreset/badge need no MC posterior; with_metrics still draws
+        # it (RoundMetrics' pool_entropy column reads the predictive samples).
+        if strat not in ("random", "coreset", "badge") or with_metrics:
             probs = learner.predict_proba_samples(net, pool_x, k_mc)
         if strat == "random":
             scores = jax.random.uniform(k_rand, (st.n_pool,))
@@ -218,9 +249,44 @@ def _make_neural_round_core(
             emb = learner.embed(net, pool_x)
             mass = jnp.maximum(similarity_mass(emb, unlabeled), 0.0)
             scores = ent * jnp.power(mass, beta)
+        elif strat == "coreset":
+            # k-Center-Greedy in-scan: centers are the real labeled rows
+            # (mesh-padding sentinels excluded), same as the per-round loop.
+            space = (
+                learner.embed(net, pool_x)
+                if coreset_space == "embedding"
+                else pool_x
+            )
+            picked, vals = deep.coreset_select(
+                space, fit_mask, window_size, selectable_mask=unlabeled
+            )
+            scores = deep.coreset_min_dists(space, fit_mask)
+        elif strat == "badge":
+            mean_probs = learner.predict_proba(net, pool_x)
+            emb = learner.embed(net, pool_x)
+            picked = deep.badge_select(
+                mean_probs, emb, unlabeled, window_size, k_rand
+            )
+            # Proxy score vector for RoundMetrics: the gradient-embedding
+            # norm |g ⊗ h|² (badge's own D² seed weights; CSE'd in-program).
+            g = mean_probs - jax.nn.one_hot(
+                jnp.argmax(mean_probs, axis=-1), mean_probs.shape[-1]
+            )
+            h = emb.reshape(emb.shape[0], -1).astype(jnp.float32)
+            scores = jnp.sum(g * g, axis=1) * jnp.sum(h * h, axis=1)
+            vals = scores[picked]
+        elif strat == "batchbald":
+            max_configs, candidate_pool, mc_samples = batchbald_params
+            picked, vals = deep.batchbald_select(
+                probs, unlabeled, window_size,
+                max_configs, candidate_pool, mc_samples,
+                key=k_rand,
+            )
+            scores = deep.bald_score(probs)
         else:
             scores = _SCORES[strat](probs)
-        vals, picked = select_top_k(scores, unlabeled, window_size)
+        if strat not in ("coreset", "badge", "batchbald"):
+            vals, picked = select_top_k(scores, unlabeled, window_size)
         new_st = state_lib.reveal(st, picked)
 
         acc = jnp.mean(
@@ -254,6 +320,8 @@ def make_neural_chunk_fn(
     with_metrics: bool = False,
     n_classes: int = 2,
     stream_cb=None,
+    coreset_space: str = "input",
+    batchbald_params=_BATCHBALD_DEFAULTS,
 ):
     """Fuse ``chunk_size`` neural AL rounds into ONE jitted ``lax.scan``.
 
@@ -281,11 +349,13 @@ def make_neural_chunk_fn(
     scores, pool entropy from the MC predictive samples — closing the
     ROADMAP follow-up that fused runs had host-side round events only).
 
-    Only strategies in :data:`FUSABLE_STRATEGIES` are supported; the caller
-    (``run_neural_experiment``) falls back to the per-round loop otherwise.
-    The carry is NOT donated: the pipelined driver's touchdown may checkpoint
-    the post-chunk ``(net, state, key)`` after the next chunk already
-    launched, which donation would have deleted (runtime/pipeline.py notes).
+    Every registered deep strategy is in :data:`FUSABLE_STRATEGIES` as of
+    PR 10 (the greedy batch selects — batchbald/coreset/badge — run their
+    static unrolls inside the scan body, which is traced once regardless of
+    K). The carry is NOT donated: the pipelined driver's touchdown may
+    checkpoint the post-chunk ``(net, state, key)`` after the next chunk
+    already launched, which donation would have deleted
+    (runtime/pipeline.py notes).
     """
     if strat not in FUSABLE_STRATEGIES:
         raise ValueError(
@@ -295,7 +365,8 @@ def make_neural_chunk_fn(
     from distributed_active_learning_tpu.runtime.pipeline import ChunkExtras
 
     round_core = _make_neural_round_core(
-        learner, strat, window_size, beta, with_metrics, n_classes
+        learner, strat, window_size, beta, with_metrics, n_classes,
+        coreset_space=coreset_space, batchbald_params=batchbald_params,
     )
 
     @jax.jit
@@ -347,6 +418,8 @@ def make_neural_sweep_chunk_fn(
     beta: float = 1.0,
     with_metrics: bool = False,
     n_classes: int = 2,
+    coreset_space: str = "input",
+    batchbald_params=_BATCHBALD_DEFAULTS,
 ):
     """:func:`make_neural_chunk_fn` vmapped over a leading experiment axis E.
 
@@ -376,7 +449,8 @@ def make_neural_sweep_chunk_fn(
     from distributed_active_learning_tpu.runtime.pipeline import ChunkExtras
 
     round_core = _make_neural_round_core(
-        learner, strat, window_size, beta, with_metrics, n_classes
+        learner, strat, window_size, beta, with_metrics, n_classes,
+        coreset_space=coreset_space, batchbald_params=batchbald_params,
     )
 
     @jax.jit
@@ -454,8 +528,8 @@ def run_neural_sweep(
     :func:`run_neural_experiment` runs with ``seed=s`` substituted: every
     per-seed key (pool state, loop key, network init) derives exactly as the
     serial driver derives it, and the vmapped chunk runs the serial round
-    body per lane. Falls back to E serial runs for strategies outside
-    :data:`FUSABLE_STRATEGIES` and for per-phase debugging. Mesh sharding
+    body per lane. Falls back to E serial runs for per-phase debugging (every
+    registered deep strategy fuses as of PR 10). Mesh sharding
     and checkpointing are not supported by the batched path (a mesh config
     falls back serially; ``checkpoint_dir`` raises — one file per seed would
     need the grid format, a follow-up).
@@ -547,6 +621,12 @@ def run_neural_sweep(
         beta=cfg.beta,
         with_metrics=want_metrics,
         n_classes=max(n_classes, 2),
+        coreset_space=cfg.coreset_space,
+        batchbald_params=(
+            cfg.batchbald_max_configs,
+            cfg.batchbald_candidate_pool,
+            cfg.batchbald_mc_samples,
+        ),
     )
     launches = telemetry.LaunchTracker(
         metrics, "neural_sweep_chunk_scan", fn=chunk_fn
@@ -716,9 +796,9 @@ def run_neural_experiment(
 
     # Scan-fused + pipelined driver (the forest loop's PR-2/PR-4 discipline
     # applied to the neural path): K rounds per launch, touchdowns overlapped
-    # with the next chunk's execution, stop decisions off two scalars.
-    # Host-bound acquire programs (batchbald/coreset/badge) and explicit
-    # per-phase timing requests fall back to the per-round loop below.
+    # with the next chunk's execution, stop decisions off two scalars. Every
+    # deep strategy fuses (PR 10 folded the greedy batch selects in); only
+    # explicit per-phase timing requests take the per-round loop below.
     use_chunked = (
         cfg.rounds_per_launch > 1
         and strat in FUSABLE_STRATEGIES
@@ -751,6 +831,12 @@ def run_neural_experiment(
             with_metrics=want_metrics,
             n_classes=max(n_classes, 2),
             stream_cb=stream_cb,
+            coreset_space=cfg.coreset_space,
+            batchbald_params=(
+                cfg.batchbald_max_configs,
+                cfg.batchbald_candidate_pool,
+                cfg.batchbald_mc_samples,
+            ),
         )
         launches = telemetry.LaunchTracker(metrics, "neural_chunk_scan", fn=chunk_fn)
         end_round = (

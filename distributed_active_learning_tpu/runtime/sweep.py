@@ -460,7 +460,9 @@ def run_sweep(
     # one fit program wide enough for the widest window.
     from distributed_active_learning_tpu.ops import trees_train
 
-    binned = trees_train.make_bins(jnp.asarray(host_x), cfg.forest.max_bins)
+    binned = trees_train.make_bins(
+        jnp.asarray(host_x), cfg.forest.max_bins, quantize=cfg.forest.quantize
+    )
     codes = binned.codes
     if states[0].n_pool > codes.shape[0]:
         codes = jnp.pad(codes, ((0, states[0].n_pool - codes.shape[0]), (0, 0)))
@@ -1172,7 +1174,10 @@ def run_grid(
             )
             for s in seeds
         ])
-        binned = trees_train.make_bins(jnp.asarray(host_x), cfg.forest.max_bins)
+        binned = trees_train.make_bins(
+            jnp.asarray(host_x), cfg.forest.max_bins,
+            quantize=cfg.forest.quantize,
+        )
         pad = n_slab - n_d
         xs.append(np.pad(host_x, ((0, pad), (0, 0))))
         oys.append(np.pad(host_y, (0, pad)))

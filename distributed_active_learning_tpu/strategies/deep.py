@@ -199,6 +199,39 @@ def batchbald_select(
     return jnp.stack(picked), jnp.stack(scores)
 
 
+def coreset_min_dists(
+    features: jnp.ndarray, labeled_mask: jnp.ndarray, chunk: int = 512
+) -> jnp.ndarray:
+    """Squared L2 distance of every pool point to its nearest labeled center
+    — the k-Center-Greedy init, exposed separately so the fused neural chunk
+    can reuse it as coreset's per-point score vector for RoundMetrics
+    (within one jitted program XLA CSEs the duplicate against
+    :func:`coreset_select`'s own init). Streams ``[chunk, n]`` Gram blocks
+    via ``lax.map``; n² never materializes. With no labeled centers every
+    distance degenerates to ``norms.max() + 1`` (uniform — the select's
+    first pick becomes deterministic argmax)."""
+    n = features.shape[0]
+    x = features.reshape(n, -1).astype(jnp.float32)
+    norms = jnp.sum(x * x, axis=1)  # [n]
+
+    col_inf = jnp.where(labeled_mask, 0.0, jnp.inf)  # +inf hides unlabeled cols
+
+    def init_chunk(args):
+        xc, nc = args
+        g = nc[:, None] + norms[None, :] - 2.0 * (xc @ x.T)  # [chunk, n]
+        return jnp.min(g + col_inf[None, :], axis=1)
+
+    pad = (-n) % chunk
+    xp = jnp.pad(x, ((0, pad), (0, 0)))
+    np_ = jnp.pad(norms, (0, pad))
+    min_dist = jax.lax.map(
+        init_chunk, (xp.reshape(-1, chunk, x.shape[1]), np_.reshape(-1, chunk))
+    ).reshape(-1)[:n]
+    # No labeled centers at all: every point is infinitely far; fall back to
+    # uniform distances so argmax degenerates to a deterministic first pick.
+    return jnp.where(jnp.isfinite(min_dist), min_dist, norms.max() + 1.0)
+
+
 @functools.partial(jax.jit, static_argnums=(2, 3))
 def coreset_select(
     features: jnp.ndarray,
@@ -230,23 +263,7 @@ def coreset_select(
     n = features.shape[0]
     x = features.reshape(n, -1).astype(jnp.float32)
     norms = jnp.sum(x * x, axis=1)  # [n]
-
-    col_inf = jnp.where(labeled_mask, 0.0, jnp.inf)  # +inf hides unlabeled cols
-
-    def init_chunk(args):
-        xc, nc = args
-        g = nc[:, None] + norms[None, :] - 2.0 * (xc @ x.T)  # [chunk, n]
-        return jnp.min(g + col_inf[None, :], axis=1)
-
-    pad = (-n) % chunk
-    xp = jnp.pad(x, ((0, pad), (0, 0)))
-    np_ = jnp.pad(norms, (0, pad))
-    min_dist = jax.lax.map(
-        init_chunk, (xp.reshape(-1, chunk, x.shape[1]), np_.reshape(-1, chunk))
-    ).reshape(-1)[:n]
-    # No labeled centers at all: every point is infinitely far; fall back to
-    # uniform distances so argmax degenerates to a deterministic first pick.
-    min_dist = jnp.where(jnp.isfinite(min_dist), min_dist, norms.max() + 1.0)
+    min_dist = coreset_min_dists(features, labeled_mask, chunk)
 
     selectable = ~labeled_mask if selectable_mask is None else selectable_mask
     picked = []

@@ -41,7 +41,16 @@ import pytest  # noqa: E402
 # and sum per file), unknown files are charged a default per test, and a
 # whole-suite collection whose estimate exceeds the budget is refused with
 # instructions instead of being quietly cut off mid-run.
-_TIER1_BUDGET_SECONDS = 800.0  # 870 s window minus collection + margin
+# PR-10 re-anchor: the estimates were re-measured end to end on the verify
+# box (the prior table understated several files — flight_recorder carried
+# 11.4s for a measured 59.2s, leaving the REAL margin near zero while the
+# estimate read 776.7/800). The table now holds honest full-run numbers
+# (736.7s summed from a 747.8s run after slow-marking the heaviest
+# mesh/bench/profiler twins — full-suite runs measure ~10-25% slower than
+# the same files standalone, and the box itself varies run to run, so the
+# ~120s of real margin is deliberate, not slack to spend). Adding tests
+# still requires slow-marking or trimming elsewhere — by design.
+_TIER1_BUDGET_SECONDS = 850.0
 _DEFAULT_PER_TEST_SECONDS = 1.5
 
 

@@ -591,3 +591,71 @@ def test_cli_json_and_exit_codes(capsys):
     assert main(["--list", "--kinds", "sweep"]) == 0
     out = capsys.readouterr().out
     assert "sweep/uncertainty/cpu" in out
+
+
+# ---------------------------------------------------------------------------
+# PR 10: the fused_chunk kind + the quantized-leaf-upcast rule
+# ---------------------------------------------------------------------------
+
+def test_registry_covers_fused_chunk_kind():
+    """Every megakernel-served strategy plus the quantized-storage variants
+    appear in both placements (the registry-name check is string-only; the
+    CI analysis job traces them all)."""
+    from distributed_active_learning_tpu.ops.round_fused import FUSED_STRATEGIES
+
+    names = {s.name for s in build_registry(kinds=["fused_chunk"])}
+    for strat in FUSED_STRATEGIES:
+        for placement in ("cpu", "mesh4x2"):
+            assert f"fused_chunk/{strat}/{placement}" in names
+    for variant in ("uncertainty-bf16", "uncertainty-int8"):
+        assert f"fused_chunk/{variant}/cpu" in names
+
+
+def test_quantized_leaf_upcast_rule_fires_on_unquantized_program():
+    """Declaring quantize on a program with no narrow storage anywhere must
+    produce the finding (the 'quantization silently dropped' shape) — a
+    minimal f32-only program stands in for an un-narrowed fit."""
+    unit = AuditUnit(
+        name="fixture/quantize-dropped",
+        fn=jax.jit(lambda x: x * 2.0),
+        args=(_sds((8,), jnp.float32),),
+        quantize="int8",
+    )
+    fired = _rules_fired(audit_unit(unit))
+    assert "quantized-leaf-upcast" in fired
+
+
+@pytest.mark.slow  # one heavy trace; the CI analysis job audits the full
+# registry (quantized variants included) on every PR
+def test_quantized_fused_chunk_audits_clean():
+    report = run_audit(
+        build_registry(
+            strategies=["uncertainty-int8"], kinds=["fused_chunk"],
+            placements=["cpu"],
+        )
+    )
+    assert report.programs == ["fused_chunk/uncertainty-int8/cpu"]
+    assert report.findings == [], [str(f) for f in report.findings]
+
+
+def test_specs_for_experiment_fused_round_routes_to_fused_chunk():
+    """A --fused-round run must audit the megakernel chunk it will launch,
+    including the quantized-storage spelling."""
+    import dataclasses
+
+    from distributed_active_learning_tpu.analysis import specs_for_experiment
+    from distributed_active_learning_tpu.config import (
+        ExperimentConfig,
+        ForestConfig,
+    )
+
+    cfg = dataclasses.replace(
+        ExperimentConfig(fused_round=True),
+        forest=ForestConfig(fit="device", quantize="int8"),
+    )
+    specs = specs_for_experiment(cfg)
+    assert [s.name for s in specs] == ["fused_chunk/uncertainty-int8/cpu"]
+    assert (
+        [s.name for s in specs_for_experiment(ExperimentConfig())]
+        == ["chunk/uncertainty/cpu"]
+    )

@@ -69,6 +69,10 @@ def test_bench_density_contract(bench):
     assert r["density_scores_per_sec"] > 0
 
 
+@pytest.mark.slow  # ~30s: times the fused AND unfused chunk legs; the CI
+# smoke-bench job runs the real `bench.py --mode round` with the same
+# payload asserts (speedup > 1, recompiles == 0), and the score/density/
+# grid/sweep contracts keep bench.py itself tier-1-covered (PR-10 budget)
 def test_bench_round_contract(bench):
     r = bench.bench_round(_args())
     assert r["round_seconds"] > 0 and r["round_seconds_host_fit"] > 0
@@ -89,6 +93,16 @@ def test_bench_round_contract(bench):
     assert roof["chunk"]["rounds_per_launch"] >= 1
     # fused-round flops can't be less than its fit half's
     assert roof["round"]["flops"] >= roof["fit"]["flops"]
+    # PR-10 megakernel legs: fused vs unfused chunk on identical inputs,
+    # speedup + namespaced recompile counter + a priced roofline row
+    assert r["fused_round_kernel"] == "gemm"  # CPU runs the XLA stream
+    assert r["fused_scan_seconds_per_round"] > 0
+    assert r["unfused_scan_seconds_per_round"] > 0
+    assert r["fused_round_speedup"] > 0
+    assert r["fused_round_recompiles_after_warmup"] == 0
+    assert r["recompiles_after_warmup"] == 0
+    fused_roof = roof["fused_round"]
+    assert fused_roof["flops"] > 0 and "bound" in fused_roof, fused_roof
 
 
 def test_mode_all_deadline_skips_are_structured(bench):

@@ -424,10 +424,10 @@ def test_cli_grid_rejects_unknown_and_stream_rounds(tmp_path):
 
 
 def test_cli_neural_sweep_seeds_routes_to_batched_loop(capsys, monkeypatch):
-    """--neural --sweep-seeds on a fusable deep strategy routes to the
-    batched neural sweep (stubbed here — the real batched-vs-serial parity
-    runs in tests/test_grid.py); greedy per-round strategies are refused
-    with guidance."""
+    """--neural --sweep-seeds routes to the batched neural sweep (stubbed
+    here — the real batched-vs-serial parity runs in tests/test_grid.py) for
+    every deep strategy, the greedy batch selects included (PR 10 folded
+    batchbald/coreset/badge into the scanned chunk)."""
     from distributed_active_learning_tpu.runtime import neural_loop
     from distributed_active_learning_tpu.runtime.results import (
         ExperimentResult,
@@ -452,8 +452,13 @@ def test_cli_neural_sweep_seeds_routes_to_batched_loop(capsys, monkeypatch):
     assert calls["seeds"] == [0, 1]
     lines = [json.loads(l) for l in capsys.readouterr().out.strip().splitlines()]
     assert {l["seed"] for l in lines} == {0, 1}
-    with pytest.raises(SystemExit):
-        main([
-            "--neural", "--strategy", "deep.batchbald", "--sweep-seeds", "2",
-            "--rounds", "1", "--quiet",
-        ])
+    # the greedy batch strategies route to the SAME batched sweep since
+    # PR 10 folded their selections into the scanned chunk (no refusal)
+    rc = main([
+        "--neural", "--strategy", "deep.batchbald",
+        "--dataset", "checkerboard2x2", "--n-samples", "80",
+        "--sweep-seeds", "2", "--window", "8", "--rounds", "1",
+        "--train-steps", "5", "--mc-samples", "2", "--quiet", "--json",
+    ])
+    assert rc == 0
+    assert calls["seeds"] == [0, 1]

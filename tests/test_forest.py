@@ -198,6 +198,9 @@ def test_load_or_train_corrupt_file_warns_and_retrains(tmp_path):
     assert forest.feature.shape[0] == 2
 
 
+@pytest.mark.slow  # ~27s (two regressor fits) for a persistence edge case;
+# the load_or_train round-trip above and the LAL strategy/CLI/parity tests
+# keep the regressor itself tier-1-covered (PR-10 budget pass)
 def test_lal_regressor_model_path_survives_cache_reset(tmp_path, monkeypatch):
     """lal_model_path persists the fitted regressor across 'process restarts'
     (simulated by clearing the in-memory cache): the second call must load,

@@ -184,6 +184,9 @@ def test_grid_falls_back_to_serial_for_host_fit():
         assert all(r.train_time > 0 for r in cell.result.records)
 
 
+@pytest.mark.slow  # ~17s mesh twin: CPU grid parity stays tier-1 above, the
+# mesh acceptance variant was already slow, and the analysis CI job audits
+# grid/.../mesh4x2 statically (PR-10 budget pass)
 def test_grid_on_sharded_mesh(devices):
     """Heterogeneous groups under the 4x2 mesh (gemm kernel for compile
     weight): batching, grouping, and sharding are all placement/launch

@@ -237,3 +237,91 @@ def test_neural_strategy_beats_random_auc():
     }
     assert means["bald"] > means["random"] + 0.08, means
     assert means["badge"] > means["random"], means
+
+
+# ---------------------------------------------------------------------------
+# PR 10: the greedy batch strategies fuse into the scanned chunk
+# ---------------------------------------------------------------------------
+
+def test_every_deep_strategy_is_fusable():
+    """batchbald/coreset/badge no longer take the per-round fallback: the
+    fusable set covers the whole deep registry (their greedy selections are
+    static unrolls inside the once-traced scan body)."""
+    from distributed_active_learning_tpu.runtime.neural_loop import (
+        FUSABLE_STRATEGIES,
+        _deep_names,
+    )
+
+    assert FUSABLE_STRATEGIES == frozenset(_deep_names())
+
+
+def _greedy_parity(strategy, **cfg_kw):
+    """Fused-chunk (rounds_per_launch=2) vs per-round records, bit-for-bit.
+    train_steps is high enough that accuracy moves with the labeled set, so
+    a pick divergence in any round shifts a later accuracy."""
+    x, y, tx, ty = _pool(n=160, seed=3)
+    lr = NeuralLearner(
+        MLP(n_classes=2, hidden=(16,)), (6,), train_steps=40, mc_samples=3
+    )
+    cfg = NeuralExperimentConfig(
+        strategy=strategy, window_size=4, n_start=10, max_rounds=3, seed=5,
+        **cfg_kw,
+    )
+    import dataclasses as _dc
+
+    ref = run_neural_experiment(cfg, lr, x, y, tx, ty)
+    fused = run_neural_experiment(
+        _dc.replace(cfg, rounds_per_launch=2), lr, x, y, tx, ty
+    )
+    a = [(r.round, r.n_labeled, float(r.accuracy)) for r in ref.records]
+    b = [(r.round, r.n_labeled, float(r.accuracy)) for r in fused.records]
+    assert a == b, (strategy, a, b)
+    assert any(r.accuracy != ref.records[0].accuracy for r in ref.records[1:])
+
+
+@pytest.mark.slow  # the non-slow greedy-fuses parity lives in
+# test_pipeline.py (batchbald); these are its per-strategy twins
+def test_coreset_fuses_in_scan_bit_identical():
+    _greedy_parity("deep.coreset")
+
+
+@pytest.mark.slow  # same parity shape as coreset above, heavier selects
+def test_badge_fuses_in_scan_bit_identical():
+    _greedy_parity("deep.badge")
+
+
+@pytest.mark.slow  # same parity shape as coreset above, heavier selects
+def test_batchbald_fuses_in_scan_bit_identical():
+    _greedy_parity(
+        "deep.batchbald",
+        batchbald_max_configs=64,
+        batchbald_candidate_pool=32,
+        batchbald_mc_samples=16,
+    )
+
+
+@pytest.mark.slow  # sweep twin of the greedy parity; serial twin runs above
+def test_greedy_strategies_fuse_in_neural_sweep():
+    from distributed_active_learning_tpu.runtime.neural_loop import (
+        run_neural_sweep,
+    )
+
+    x, y, tx, ty = _pool(n=160, seed=3)
+    lr = NeuralLearner(
+        MLP(n_classes=2, hidden=(16,)), (6,), train_steps=40, mc_samples=3
+    )
+    import dataclasses as _dc
+
+    for strategy in ("deep.coreset", "deep.badge"):
+        cfg = NeuralExperimentConfig(
+            strategy=strategy, window_size=4, n_start=10, max_rounds=2,
+            rounds_per_launch=2,
+        )
+        swept = run_neural_sweep(cfg, lr, x, y, tx, ty, seeds=[0, 1])
+        for s, res in zip([0, 1], swept):
+            serial = run_neural_experiment(
+                _dc.replace(cfg, seed=s), lr, x, y, tx, ty
+            )
+            a = [(r.round, r.n_labeled, float(r.accuracy)) for r in serial.records]
+            b = [(r.round, r.n_labeled, float(r.accuracy)) for r in res.records]
+            assert a == b, (strategy, s, a, b)

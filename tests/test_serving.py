@@ -283,6 +283,9 @@ def test_slab_growth_bit_identical_to_fresh_pool_cpu():
     assert int(res_grown[0].n_filled) == meta["n_final"]
 
 
+@pytest.mark.slow  # ~7s mesh twin of the CPU growth-parity test above, which
+# stays tier-1; serve mesh programs are audited statically in CI (PR-10
+# budget pass)
 def test_slab_growth_bit_identical_on_mesh(devices):
     from distributed_active_learning_tpu.parallel import make_mesh
 

@@ -101,6 +101,9 @@ def test_sweep_matches_serial_runs_bit_identical(serial_base, tmp_path):
         assert [r.round for r in parsed.records] == [1, 2, 3, 4, 5]
 
 
+@pytest.mark.slow  # ~17s; the grid suite's staggered-stops test covers the
+# same freeze-while-laggard-continues contract non-slow, and the wider
+# E=8 window grids were already slow acceptance variants (PR-10 budget pass)
 def test_sweep_staggered_windows_and_budget_stops():
     """Heterogeneous windows (5/10/20) against a shared label budget: the
     padded selection reveals each experiment's own window, experiments
@@ -195,6 +198,8 @@ def test_strategy_curves_stacks_seed_results(serial_base):
         strategy_curves([results[0], short])
 
 
+@pytest.mark.slow  # ~10s mesh twin: CPU sweep parity stays tier-1 above and
+# the E=8 mesh acceptance variant was already slow (PR-10 budget pass)
 def test_sweep_on_sharded_mesh(devices):
     """Batch axis vmapped OUTSIDE the data-sharded pool: the 4x2-mesh sweep
     matches single-device serial runs — sharding, chunking, and batching are

@@ -258,6 +258,8 @@ def test_neural_loop_round_events(tmp_path):
     assert sum(e["kind"] == "round" for e in events) == 2
 
 
+@pytest.mark.slow  # ~12s (spins a real profiler session); the unwritable-dir
+# guard below keeps the --profile-dir plumbing tier-1-covered (PR-10 budget)
 def test_profile_session_writes_trace(tmp_path):
     """--profile-dir plumbing: profiler_trace (dead code until this PR) runs
     and leaves trace artifacts behind."""
