@@ -78,6 +78,12 @@ DEFAULT_SPECS: List[MetricSpec] = [
     MetricSpec("serve_p50_ms", "lower", 0.40),
     MetricSpec("serve_p99_ms", "lower", 0.50),
     MetricSpec("ingest_points_per_sec", "higher", 0.30),
+    # multi-tenant serving (PR-12): aggregate qps, the worst tenant's p99 at
+    # 50% (the noisy-neighbor ceiling), and ingest under contention
+    MetricSpec("serve_multi_qps", "higher", 0.30),
+    MetricSpec("serve_multi_p50_ms", "lower", 0.40),
+    MetricSpec("serve_multi_worst_tenant_p99_ms", "lower", 0.50),
+    MetricSpec("serve_multi_ingest_points_per_sec", "higher", 0.30),
     MetricSpec("lal_query_seconds", "lower", 0.30),
     MetricSpec("lal_query_device_seconds", "lower", 0.30),
     MetricSpec("cnn_round_seconds", "lower", 0.40),
@@ -94,6 +100,17 @@ DEFAULT_SPECS: List[MetricSpec] = [
         "fused_round_recompiles_after_warmup", "lower", 0.0, kind="counter",
         hard=True,
     ),
+    # serve-multi's namespaced twin, plus the AOT-precompile acceptance gate:
+    # any post-warmup query paying a slab-growth compile is an architectural
+    # regression (the p99 spike PR 12 killed), never noise
+    MetricSpec(
+        "serve_multi_recompiles_after_warmup", "lower", 0.0, kind="counter",
+        hard=True,
+    ),
+    MetricSpec(
+        "serve_multi_growth_compile_events", "lower", 0.0, kind="counter",
+        hard=True,
+    ),
     MetricSpec("chunk_jit_cache_entries", "lower", 0.0, kind="counter"),
 ]
 
@@ -105,6 +122,7 @@ VALUE_DIRECTIONS = {
     "sweep_experiments_rounds_per_second": "higher",
     "grid_cells_rounds_per_second": "higher",
     "serve_qps": "higher",
+    "serve_multi_qps": "higher",
     "al_round_seconds": "lower",
     "lal_query_seconds": "lower",
     "neural_round_seconds": "lower",

@@ -22,6 +22,9 @@ Sections:
 - **serve latency** — per-query percentiles, broken down by the concurrent
   CAUSE each query was tagged with (slab_growth_compile / refit_dispatch /
   none) so the service's p99 spike is attributable;
+- **tenants** — per-tenant latency/throughput/ingest/re-fit attribution from
+  the tenant-tagged serving events (serving/tenants.py): a noisy-neighbor
+  tenant is nameable from one JSONL;
 - **roofline** — per-program cost attribution events (run.py --roofline):
   flops/bytes, achieved rates, MFU, bound verdict;
 - **counters / gauges** — host transfer bytes, device memory watermarks.
@@ -137,6 +140,20 @@ def _table(header, rows):
     lines = [_fmt_row(header, widths), _fmt_row(["-" * w for w in widths], widths)]
     lines += [_fmt_row(r, widths) for r in rows]
     return "\n".join(lines)
+
+
+def _latency_ms(evs, q: float) -> str:
+    """Nearest-rank percentile of the events' ``seconds``, rendered in ms —
+    ONE formula shared by the serve-latency and per-tenant tables."""
+    secs = sorted(float(e["seconds"]) for e in evs)
+    return f"{secs[min(int(q * len(secs)), len(secs) - 1)] * 1e3:.3f}"
+
+
+def _events_qps(evs) -> str:
+    """Events/second over the stream's ts span ('-' when unmeasurable)."""
+    ts = [e["ts"] for e in evs if isinstance(e.get("ts"), (int, float))]
+    span = (max(ts) - min(ts)) if len(ts) > 1 else 0.0
+    return f"{len(evs) / span:.2f}" if span > 0 else "-"
 
 
 def summarize(events: List[dict]) -> str:
@@ -298,22 +315,12 @@ def summarize(events: List[dict]) -> str:
         and not isinstance(e.get("seconds"), bool)
     ]
     if serve_events:
-        ts = [
-            e["ts"] for e in serve_events
-            if isinstance(e.get("ts"), (int, float))
-        ]
-        span = (max(ts) - min(ts)) if len(ts) > 1 else 0.0
-        qps = f"{len(serve_events) / span:.2f}" if span > 0 else "-"
+        qps = _events_qps(serve_events)
 
         def _lat_row(label, evs, with_qps="-"):
-            secs = sorted(float(e["seconds"]) for e in evs)
-
-            def _pct(q):
-                return f"{secs[min(int(q * len(secs)), len(secs) - 1)] * 1e3:.3f}"
-
             return [
-                label, len(secs), _pct(0.50), _pct(0.90), _pct(0.99),
-                f"{secs[-1] * 1e3:.3f}", with_qps,
+                label, len(evs), _latency_ms(evs, 0.50), _latency_ms(evs, 0.90),
+                _latency_ms(evs, 0.99), _latency_ms(evs, 1.0), with_qps,
             ]
 
         # Per-cause breakdown (serving/service.py tags every query with the
@@ -371,6 +378,39 @@ def summarize(events: List[dict]) -> str:
             + f"{len(refits)} drift-dispatched chunk launches ("
             + ", ".join(f"{r}={n}" for r, n in sorted(by_reason.items()))
             + ")"
+        )
+
+    # Per-tenant attribution (serving/tenants.py tags serve_latency/ingest/
+    # refit events with the tenant id): one table per JSONL naming the noisy
+    # neighbor — which tenant's traffic, arrivals, and re-fits dominate, and
+    # what its own latency tail looks like. Untagged (pre-multi-tenant)
+    # streams skip the section rather than inventing a tenant.
+    tenant_ids = sorted(
+        {
+            str(e["tenant"])
+            for e in serve_events + ingests + refits
+            if "tenant" in e
+        }
+    )
+    if tenant_ids:
+        rows = []
+        for tid in tenant_ids:
+            evs = [e for e in serve_events if str(e.get("tenant")) == tid]
+            t_ing = [e for e in ingests if str(e.get("tenant")) == tid]
+            t_ref = [e for e in refits if str(e.get("tenant")) == tid]
+            points = sum(e["points"] for e in t_ing)
+            p50 = _latency_ms(evs, 0.50) if evs else "-"
+            p99 = _latency_ms(evs, 0.99) if evs else "-"
+            rows.append([
+                tid, len(evs), p50, p99, _events_qps(evs), points, len(t_ref),
+            ])
+        out.append(
+            "\n== tenants ==\n"
+            + _table(
+                ["tenant", "queries", "p50 ms", "p99 ms", "qps",
+                 "ingested", "refits"],
+                rows,
+            )
         )
 
     rooflines = [e for e in events if e.get("kind") == "roofline"]

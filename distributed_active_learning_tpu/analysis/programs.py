@@ -21,7 +21,20 @@ without data, devices beyond the host, or compilation:
                      ``score`` endpoint, and the serve ``chunk`` — the fused
                      AL chunk with the dynamic ``n_filled`` watermark leaf
                      riding the carry (the aval set a re-fit launch threads
-                     launch-to-launch).
+                     launch-to-launch);
+- ``serve_multi``  — the multi-tenant service's programs (serving/tenants.py):
+                     ``batched_score`` (the cross-tenant fused endpoint —
+                     the score body vmapped over a leading tenant axis over
+                     a stacked resident forest), ``ingest`` (the per-tenant
+                     donation-append each tenant launches under the
+                     manager), and ``chunk`` — the tenant-axis batched
+                     re-fit (the PR-9 grid chunk with tenants as the
+                     dataset axis: G=1 strategy group, D=T tenants, E=1
+                     seeds, per-tenant fills riding ``n_valids`` and the
+                     mask carry donated). The chunk carries the mesh4x2
+                     variant (the grid machinery shards); the stacked-forest
+                     endpoint and per-tenant ingest are single-device like
+                     the rest of serving.
 
 Each kind comes in two placements: ``cpu`` (single device) and ``mesh4x2``
 (the 4x2 data x model mesh with the pallas kernel shard_map-wrapped — the
@@ -61,7 +74,7 @@ FIT_BUDGET = 48
 
 KINDS = (
     "chunk", "fused_chunk", "sweep", "grid", "neural_sweep", "neural_chunk",
-    "serve",
+    "serve", "serve_multi",
 )
 GRID_D = 2   # datasets in the audited grid program
 GRID_E = 2   # seeds per (strategy, dataset)
@@ -70,6 +83,7 @@ PLACEMENTS = ("cpu", "mesh4x2")
 MESH_SHAPE = (4, 2)
 SERVE_BLOCK = 8
 SERVE_SCORE_WIDTH = 16
+SERVE_TENANTS = 2  # tenant axis of the audited serve_multi programs
 
 
 class SkipProgram(Exception):
@@ -605,6 +619,140 @@ def serve_program_names() -> List[str]:
     return ["chunk", "ingest", "score"]
 
 
+def _build_serve_multi(
+    program: str, placement: str, mesh_shape=MESH_SHAPE
+) -> AuditUnit:
+    """The multi-tenant service's programs (serving/tenants.py). The
+    tenant-axis ``chunk`` is the grid machinery and carries the mesh
+    variant; the stacked-forest ``batched_score`` endpoint and the
+    per-tenant ``ingest`` are single-device (the pod-sharded service is the
+    ROADMAP follow-up)."""
+    from distributed_active_learning_tpu.serving import slab as slab_lib
+    from distributed_active_learning_tpu.serving import tenants as tenants_lib
+
+    T = SERVE_TENANTS
+    if program == "batched_score":
+        if placement != "cpu":
+            raise SkipProgram(
+                "the batched score endpoint stacks per-tenant forests on one "
+                "device (pod-sharded serving is a ROADMAP item); no mesh "
+                "variant"
+            )
+        forest = jax.eval_shape(
+            _device_fit("gemm"),
+            _sds((POOL_ROWS, FEATURES), jnp.int32),
+            _abstract_state(),
+            _key_sds(),
+        )
+        stacked = jax.tree.map(
+            lambda l: _sds((T,) + tuple(l.shape), l.dtype), forest
+        )
+        args = (stacked, _sds((T, SERVE_SCORE_WIDTH, FEATURES), jnp.float32))
+        return AuditUnit(
+            name=f"serve_multi/batched_score/{placement}",
+            fn=tenants_lib.make_batched_score_fn(),
+            args=args,
+            expect_donation=False,
+        )
+    if program == "ingest":
+        if placement != "cpu":
+            raise SkipProgram(
+                "per-tenant ingest is a single-device donation write "
+                "(pod-sharded serving is a ROADMAP item); no mesh variant"
+            )
+        # The per-tenant ingest each tenant launches under the manager — the
+        # same program shape as serve/ingest, audited under this kind so the
+        # serve_multi surface is self-contained.
+        slab = slab_lib.SlabPool(
+            x=_sds((POOL_ROWS, FEATURES), jnp.float32),
+            oracle_y=_sds((POOL_ROWS,), jnp.int32),
+            labeled_mask=_sds((POOL_ROWS,), jnp.bool_),
+            codes=_sds((POOL_ROWS, FEATURES), jnp.int32),
+            n_filled=_sds((), jnp.int32),
+            slab_rows=POOL_ROWS,
+        )
+        args = (
+            slab,
+            _sds((FEATURES, MAX_BINS - 1), jnp.float32),
+            _sds((SERVE_BLOCK, FEATURES), jnp.float32),
+            _sds((SERVE_BLOCK,), jnp.int32),
+            _sds((), jnp.int32),
+        )
+        return AuditUnit(
+            name=f"serve_multi/ingest/{placement}",
+            fn=slab_lib.make_ingest_fn(),
+            args=args,
+            expect_donation=True,
+            carry_in_argnums=(0,),
+            carry_out_index=0,
+        )
+    if program == "chunk":
+        # The tenant-axis batched re-fit: the grid chunk with tenants as the
+        # dataset axis (G=1, D=T, E=1), per-tenant fills riding n_valids and
+        # the mask/key/round carry donated — the donation/carry-aval
+        # invariants the rules audit are exactly what the manager's
+        # dispatch-rebind choreography depends on.
+        from distributed_active_learning_tpu.runtime.loop import make_grid_device_fit
+        from distributed_active_learning_tpu.runtime.sweep import (
+            SweepState,
+            make_grid_chunk_fn,
+        )
+
+        mesh = _mesh_or_skip(mesh_shape) if placement != "cpu" else None
+        kernel = "pallas" if mesh is not None else "gemm"
+        strategy, _aux = _strategy_and_aux("uncertainty")
+        grid_fit = make_grid_device_fit(
+            _forest_cfg(kernel), FIT_BUDGET, n_classes=2
+        )
+        chunk_fn = make_grid_chunk_fn(
+            [strategy], WINDOW, CHUNK_ROUNDS, grid_fit,
+            n_datasets=T,
+            n_seeds=1,
+            use_fill=True,
+            use_test_fill=True,
+            mesh=mesh,
+            wrap_pallas=mesh is not None,
+            with_metrics=True,
+            n_classes=2,
+        )
+        grid_state = SweepState(
+            labeled_mask=_sds((T, POOL_ROWS), jnp.bool_),
+            key=_key_sds((T,)),
+            round=_sds((T,), jnp.int32),
+        )
+        args = (
+            _sds((T, POOL_ROWS, FEATURES), jnp.int32),       # codes
+            _sds((T, POOL_ROWS, FEATURES), jnp.float32),     # x
+            _sds((T, POOL_ROWS), jnp.int32),                 # oracle_y
+            grid_state,                                       # donated carry
+            _sds((T, POOL_ROWS), jnp.bool_),                 # seed_masks
+            (None,),                                          # lal_forests
+            _key_sds((T,)),                                   # fit_keys
+            _sds((T,), jnp.int32),                           # windows
+            _sds((T, TEST_ROWS, FEATURES), jnp.float32),     # test_x
+            _sds((T, TEST_ROWS), jnp.int32),                 # test_y
+            _sds((T,), jnp.int32),                           # end_rounds
+            _sds((T,), jnp.int32),                           # label_caps
+            _sds((T, FEATURES, MAX_BINS - 1), jnp.float32),  # edges
+            _sds((T,), jnp.int32),                           # n_valids
+            _sds((T,), jnp.int32),                           # test_ns
+        )
+        return AuditUnit(
+            name=f"serve_multi/chunk/{placement}",
+            fn=chunk_fn,
+            args=args,
+            expect_donation=True,
+            with_metrics=True,
+            carry_in_argnums=(3,),
+            carry_out_index=0,
+        )
+    raise ValueError(f"unknown serve_multi program {program!r}")
+
+
+def serve_multi_program_names() -> List[str]:
+    return ["batched_score", "chunk", "ingest"]
+
+
 # ---------------------------------------------------------------------------
 # the registry
 # ---------------------------------------------------------------------------
@@ -657,12 +805,16 @@ def build_registry(
         ("neural_sweep", _build_neural_sweep, neural_strategy_names()),
         ("neural_chunk", _build_neural_chunk, neural_strategy_names()),
         ("serve", _build_serve, serve_program_names()),
+        # the multi-tenant serving surface: the tenant-axis chunk audits in
+        # both placements (the grid machinery shards); batched_score/ingest
+        # skip mesh with a named reason inside the builder
+        ("serve_multi", _build_serve_multi, serve_multi_program_names()),
     ):
         if kind not in kinds:
             continue
-        # the neural loop and the serving programs have a single (cpu)
-        # placement — emit it only when cpu was requested, so a mesh-only
-        # filter doesn't smuggle cpu programs back into the audit
+        # the neural loop and the single-tenant serving programs have a
+        # single (cpu) placement — emit it only when cpu was requested, so a
+        # mesh-only filter doesn't smuggle cpu programs back into the audit
         kind_placements = (
             (("cpu",) if "cpu" in placements else ())
             if kind in ("neural_sweep", "neural_chunk", "serve")

@@ -157,6 +157,18 @@ class ServeConfig:
     # Pending score requests tolerated before an in-flight re-fit chunk's
     # touchdown is forced (the event loop otherwise polls non-blockingly).
     refit_poll_events: int = 64
+    # AOT capacity precompile (serving/tenants.py): when the fill watermark
+    # comes within ``precompile_headroom_slabs`` slabs of capacity, a
+    # background thread ``lower().compile()``s the NEXT capacity's
+    # ingest/chunk/fit programs, so slab growth becomes an executable swap
+    # instead of an on-request XLA compile — the ``slab_growth_compile``
+    # p99 cause the serve bench tags must vanish after warmup.
+    precompile_ahead: bool = True
+    precompile_headroom_slabs: float = 1.0
+    # Frontend admission cap (serving/frontend.py): queued requests tolerated
+    # per tenant before new submissions are refused with AdmissionError —
+    # the backpressure signal concurrent clients actually observe.
+    max_pending: int = 64
 
 
 @dataclasses.dataclass(frozen=True)

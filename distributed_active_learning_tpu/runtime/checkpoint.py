@@ -563,6 +563,22 @@ def restore_latest_grid(
 
 
 _SERVE_STEP_RE = re.compile(r"^servestate_(\d+)\.npz$")
+# The multi-tenant axis: one file series per tenant, the tenant id embedded
+# in BOTH the name and the payload (the name routes, the payload verifies).
+# Single-tenant files ("servestate_<round>.npz") have no second underscore,
+# so the two series cannot collide in one directory.
+_SERVE_TENANT_RE = re.compile(r"^[A-Za-z0-9._-]+$")
+
+
+def _serve_step_re(tenant: Optional[str]) -> "re.Pattern[str]":
+    if tenant is None:
+        return _SERVE_STEP_RE
+    if not _SERVE_TENANT_RE.fullmatch(tenant):
+        raise ValueError(
+            f"serve checkpoint tenant id {tenant!r} must match "
+            f"{_SERVE_TENANT_RE.pattern} (it names files)"
+        )
+    return re.compile(rf"^servestate_{re.escape(tenant)}_(\d+)\.npz$")
 
 
 def save_serve(
@@ -571,6 +587,7 @@ def save_serve(
     forest,
     result: ExperimentResult,
     fingerprint: Optional[str] = None,
+    tenant: Optional[str] = None,
 ) -> Optional[str]:
     """Streaming-service checkpoint: slab fill watermark + mask + ingested
     points + the resident fitted forest.
@@ -585,9 +602,17 @@ def save_serve(
     it is an allocation detail, and the restore re-pads to the restoring
     service's own ``slab_rows`` (the slab-growth parity tests prove tail
     content is unobservable).
+
+    ``tenant`` is the multi-tenant axis (serving/tenants.py): each tenant
+    writes its own ``servestate_<tenant>_<round>.npz`` series into the
+    shared directory, with the id stored in the payload so a restore can
+    refuse a cross-wired file even if someone renames it. ``None`` keeps the
+    PR-7 single-tenant names — old checkpoints stay restorable, new
+    single-tenant services stay byte-compatible.
     """
     from distributed_active_learning_tpu.parallel.multihost import host_np
 
+    _serve_step_re(tenant)  # validates the id before any work
     if state.n_filled is None:
         raise ValueError("save_serve needs a slab-paged state (n_filled set)")
     fill = int(state.n_filled)
@@ -613,23 +638,29 @@ def save_serve(
         payload["config_fingerprint"] = np.frombuffer(
             fingerprint.encode(), dtype=np.uint8
         )
+    if tenant is not None:
+        payload["tenant_id"] = np.frombuffer(tenant.encode(), dtype=np.uint8)
     if jax.process_index() != 0:
         return None
     os.makedirs(ckpt_dir, exist_ok=True)
     from distributed_active_learning_tpu.utils.io import atomic_savez
 
-    return atomic_savez(
-        os.path.join(ckpt_dir, f"servestate_{int(state.round)}.npz"), **payload
+    stem = (
+        f"servestate_{int(state.round)}.npz"
+        if tenant is None
+        else f"servestate_{tenant}_{int(state.round)}.npz"
     )
+    return atomic_savez(os.path.join(ckpt_dir, stem), **payload)
 
 
-def latest_serve_step(ckpt_dir: str) -> Optional[int]:
+def latest_serve_step(ckpt_dir: str, tenant: Optional[str] = None) -> Optional[int]:
     if not os.path.isdir(ckpt_dir):
         return None
+    pat = _serve_step_re(tenant)
     steps = [
         int(m.group(1))
         for fn in os.listdir(ckpt_dir)
-        if (m := _SERVE_STEP_RE.match(fn))
+        if (m := pat.match(fn))
     ]
     return max(steps) if steps else None
 
@@ -638,6 +669,7 @@ def restore_latest_serve(
     ckpt_dir: str,
     forest_template,
     fingerprint: Optional[str] = None,
+    tenant: Optional[str] = None,
 ):
     """Load the newest service checkpoint; ``None`` if none exists.
 
@@ -646,12 +678,19 @@ def restore_latest_serve(
     ``forest_template`` (the pytree ``jax.eval_shape`` of the service's own
     fit program produces; leaf count/shape mismatches mean a differently-
     configured forest and raise rather than resume garbage). A fingerprint
-    mismatch raises, as in :func:`restore_latest`.
+    mismatch raises, as in :func:`restore_latest`. ``tenant`` selects that
+    tenant's file series (see :func:`save_serve`); the id stored in the
+    payload must match, so a renamed file cannot cross-wire tenants.
     """
-    step = latest_serve_step(ckpt_dir)
+    step = latest_serve_step(ckpt_dir, tenant=tenant)
     if step is None:
         return None
-    with np.load(os.path.join(ckpt_dir, f"servestate_{step}.npz")) as z:
+    stem = (
+        f"servestate_{step}.npz"
+        if tenant is None
+        else f"servestate_{tenant}_{step}.npz"
+    )
+    with np.load(os.path.join(ckpt_dir, stem)) as z:
         stored_fp = (
             bytes(z["config_fingerprint"]).decode()
             if "config_fingerprint" in z.files
@@ -661,6 +700,15 @@ def restore_latest_serve(
             raise ValueError(
                 f"serve checkpoint fingerprint {stored_fp} != current service "
                 f"{fingerprint}: refusing to resume a different service's pool"
+            )
+        stored_tenant = (
+            bytes(z["tenant_id"]).decode() if "tenant_id" in z.files else None
+        )
+        if tenant is not None and stored_tenant != tenant:
+            raise ValueError(
+                f"serve checkpoint {stem} stores tenant "
+                f"{stored_tenant!r}, not {tenant!r}: refusing to cross-wire "
+                "tenants from a renamed file"
             )
         x = z["x"]
         y = z["oracle_y"]
@@ -677,7 +725,7 @@ def restore_latest_serve(
         )
         if stored != list(range(len(leaves))):
             raise ValueError(
-                f"servestate_{step}.npz holds {len(stored)} forest arrays but "
+                f"{stem} holds {len(stored)} forest arrays but "
                 f"this configuration's forest has {len(leaves)} — not a "
                 "checkpoint of this forest shape"
             )

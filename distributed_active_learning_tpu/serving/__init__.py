@@ -4,19 +4,30 @@
   dynamic fill watermark, donation ingest, fixed-width resident scoring;
 - :mod:`serving.drift` — entropy/margin drift triggers deciding when a
   re-fit chunk launch is worth dispatching;
-- :mod:`serving.service` — the event loop interleaving ingest drains, the
-  ``score(points)`` endpoint, and drift-gated fused AL chunk launches.
+- :mod:`serving.tenants` — the multi-tenant core: N resident tenants per
+  process, cross-tenant fused scoring (one vmapped launch over a tenant
+  axis), tenant-axis batched re-fits (the PR-9 grid chunk with tenants as
+  the dataset axis), and the background AOT capacity precompile that turns
+  slab growth into an executable swap;
+- :mod:`serving.frontend` — the thread-safe/asyncio front queue: admission
+  control, per-tenant fairness, re-fit backpressure, one dispatcher thread
+  owning all device work;
+- :mod:`serving.service` — the single-tenant compatibility front
+  (:class:`ALService` routes through a 1-tenant manager).
 
 Entry points: ``python -m distributed_active_learning_tpu.serving`` (a
-simulated stream over a registry dataset) and ``bench.py --mode serve`` (the
-sustained-qps / p99-latency benchmark).
+simulated stream over a registry dataset), ``bench.py --mode serve`` (the
+single-tenant sustained-qps / p99-latency benchmark) and ``bench.py --mode
+serve-multi`` (>= 4 tenants under mixed ingest + re-fit load, per-tenant
+p50/p99, the zero-growth-compile gate).
 """
 
 from distributed_active_learning_tpu.serving.drift import DriftMonitor  # noqa: F401
-from distributed_active_learning_tpu.serving.service import (  # noqa: F401
-    ALService,
-    ServeStats,
+from distributed_active_learning_tpu.serving.frontend import (  # noqa: F401
+    AdmissionError,
+    ServiceFrontend,
 )
+from distributed_active_learning_tpu.serving.service import ALService  # noqa: F401
 from distributed_active_learning_tpu.serving.slab import (  # noqa: F401
     SlabPool,
     flat_state,
@@ -24,4 +35,10 @@ from distributed_active_learning_tpu.serving.slab import (  # noqa: F401
     init_slab_pool,
     make_ingest_fn,
     make_score_fn,
+)
+from distributed_active_learning_tpu.serving.tenants import (  # noqa: F401
+    ServeStats,
+    Tenant,
+    TenantManager,
+    make_batched_score_fn,
 )

@@ -1,0 +1,277 @@
+"""Thread-safe front queue for the multi-tenant service: admission,
+fairness, backpressure.
+
+The PR-7 event loop was single-threaded BY DESIGN — one caller interleaving
+score/submit on one thread. Production clients are concurrent, so something
+must make them actually contend: this module is that something, and it keeps
+the one-device-thread discipline the whole serving stack assumes by funneling
+every device-touching operation through ONE dispatcher thread.
+
+- **Clients enqueue, the dispatcher executes.** ``submit_score`` /
+  ``submit_ingest`` append to a per-tenant FIFO and return a
+  ``concurrent.futures.Future``; blocking (``score``) and asyncio
+  (``ascore``) wrappers ride the same futures. Request payloads never touch
+  the device on the client thread.
+
+- **Admission control.** A tenant whose queue already holds ``max_pending``
+  requests (ServeConfig.max_pending) has new submissions refused with
+  :class:`AdmissionError` — bounded memory, and the backpressure signal a
+  client can act on.
+
+- **Per-tenant fairness.** Each dispatch cycle drains AT MOST one score
+  request per tenant, rotating the starting tenant round-robin — a noisy
+  tenant cannot occupy more than its slot in any fused launch while others
+  wait. The collected slots coalesce into ONE cross-tenant batched launch
+  (:meth:`~serving.tenants.TenantManager.score_many`).
+
+- **Re-fit backpressure.** While a tenant's re-fit chunk is in flight its
+  INGEST requests are held (the slab arrays are donation-bound to the
+  running chunk's output futures; piling more device writes behind a
+  long chunk just hides queueing in the device stream) — held requests stay
+  queued, the queue fills, and admission pushes back on the producer.
+  Scoring is deliberately NOT held: the resident forest stays hot through a
+  re-fit (that asymmetry is the service's core latency guarantee), so score
+  requests may overtake held ingests of the same tenant.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import dataclasses
+import threading
+import time
+from concurrent.futures import Future
+from typing import Deque, Dict, Optional
+
+import numpy as np
+
+from distributed_active_learning_tpu.runtime import telemetry
+from distributed_active_learning_tpu.serving.tenants import TenantManager
+
+
+class AdmissionError(RuntimeError):
+    """A tenant's front queue is full — the caller-visible backpressure
+    signal (retry later, shed load, or slow the producer)."""
+
+
+@dataclasses.dataclass
+class _Request:
+    kind: str            # "score" | "ingest"
+    tenant: str
+    x: np.ndarray
+    y: Optional[np.ndarray]
+    future: Future
+    enqueued: float
+
+
+class ServiceFrontend:
+    """The concurrent front of a :class:`~serving.tenants.TenantManager`.
+
+    Use as a context manager (or ``start()``/``stop()``); clients then call
+    ``score``/``submit_score``/``submit_ingest``/``ascore`` from any thread
+    or event loop. One dispatcher thread owns all device work.
+    """
+
+    def __init__(
+        self,
+        manager: TenantManager,
+        max_pending: Optional[int] = None,
+        idle_poll_seconds: float = 0.002,
+    ):
+        self.manager = manager
+        self._max_pending = max_pending
+        self._idle_poll = idle_poll_seconds
+        self._queues: Dict[str, Deque[_Request]] = {}
+        self._cond = threading.Condition()
+        self._rr = 0
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+        self.dispatch_cycles = 0
+        self.fused_launch_cycles = 0
+        self.held_ingest_cycles = 0
+        self.rejected: Dict[str, int] = {}
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "ServiceFrontend":
+        with self._cond:
+            if self._running:
+                return self
+            self._running = True
+        self._thread = threading.Thread(
+            target=self._dispatch_loop, name="serve-frontend", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self, drain: bool = True, timeout: Optional[float] = 30.0) -> None:
+        """Stop the dispatcher; ``drain=True`` first serves everything still
+        queued (a held ingest drains once its tenant's re-fit touches down)."""
+        if drain:
+            deadline = None if timeout is None else time.monotonic() + timeout
+            while self.pending() and (
+                deadline is None or time.monotonic() < deadline
+            ):
+                time.sleep(self._idle_poll)
+        with self._cond:
+            self._running = False
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def __enter__(self) -> "ServiceFrontend":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- client surface ------------------------------------------------------
+
+    def _cap_for(self, tenant: str) -> int:
+        if self._max_pending is not None:
+            return self._max_pending
+        return self.manager.tenant(tenant).serve.max_pending
+
+    def _enqueue(self, req: _Request) -> Future:
+        cap = self._cap_for(req.tenant)
+        with self._cond:
+            if not self._running:
+                raise RuntimeError("frontend is not running (call start())")
+            q = self._queues.setdefault(req.tenant, collections.deque())
+            if len(q) >= cap:
+                self.rejected[req.tenant] = self.rejected.get(req.tenant, 0) + 1
+                raise AdmissionError(
+                    f"tenant {req.tenant!r} has {len(q)} pending requests "
+                    f"(max_pending={cap}); backpressure — retry later"
+                )
+            q.append(req)
+            self._cond.notify()
+        return req.future
+
+    def submit_score(self, tenant: str, queries) -> Future:
+        """Enqueue a score request; the Future resolves to the scores array."""
+        self.manager.tenant(tenant)  # KeyError now, not on the dispatcher
+        q = np.asarray(queries, np.float32)
+        return self._enqueue(
+            _Request("score", tenant, q, None, Future(), time.perf_counter())
+        )
+
+    def submit_ingest(self, tenant: str, x, y) -> Future:
+        """Enqueue an ingest block; the Future resolves to an ack dict."""
+        self.manager.tenant(tenant)
+        return self._enqueue(
+            _Request(
+                "ingest", tenant,
+                np.asarray(x, np.float32), np.asarray(y, np.int32),
+                Future(), time.perf_counter(),
+            )
+        )
+
+    def score(self, tenant: str, queries, timeout: Optional[float] = None):
+        """Blocking convenience wrapper: enqueue + wait."""
+        return self.submit_score(tenant, queries).result(timeout)
+
+    async def ascore(self, tenant: str, queries):
+        """asyncio client surface over the same queue/futures."""
+        return await asyncio.wrap_future(self.submit_score(tenant, queries))
+
+    async def asubmit(self, tenant: str, x, y):
+        return await asyncio.wrap_future(self.submit_ingest(tenant, x, y))
+
+    def pending(self, tenant: Optional[str] = None) -> int:
+        with self._cond:
+            if tenant is not None:
+                return len(self._queues.get(tenant, ()))
+            return sum(len(q) for q in self._queues.values())
+
+    # -- the dispatcher ------------------------------------------------------
+
+    def _collect(self):
+        """One fairness cycle under the lock: at most one score request per
+        tenant (rotating start), ingest heads for tenants whose re-fit is
+        NOT in flight. Returns (scores, ingests, held_any)."""
+        scores: Dict[str, _Request] = {}
+        ingests = []
+        held = False
+        tids = list(self._queues)
+        n = len(tids)
+        for k in range(n):
+            tid = tids[(self._rr + k) % n]
+            q = self._queues[tid]
+            if not q:
+                continue
+            head = q[0]
+            if head.kind == "ingest":
+                if self.manager.tenant(tid).refit_inflight:
+                    # backpressure: hold the ingest, but let a queued score
+                    # overtake it — the resident forest stays hot
+                    held = True
+                    for i, req in enumerate(q):
+                        if req.kind == "score":
+                            del q[i]
+                            scores[tid] = req
+                            break
+                    continue
+                ingests.append(q.popleft())
+                # an ingest and a score from one tenant may share a cycle
+                if q and q[0].kind == "score":
+                    scores[tid] = q.popleft()
+            else:
+                scores[tid] = q.popleft()
+        if n:
+            self._rr = (self._rr + 1) % n
+        return scores, ingests, held
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            with self._cond:
+                while self._running and not any(self._queues.values()):
+                    self._cond.wait(timeout=0.1)
+                if not self._running:
+                    return
+                scores, ingests, held = self._collect()
+            self.dispatch_cycles += 1
+            # A client may have cancelled a still-queued Future (asyncio
+            # timeouts do); claiming it via set_running_or_notify_cancel
+            # drops cancelled requests AND makes the set_result/set_exception
+            # below safe — an unguarded InvalidStateError here would kill the
+            # one thread serving everybody.
+            ingests = [r for r in ingests if r.future.set_running_or_notify_cancel()]
+            scores = {
+                tid: r for tid, r in scores.items()
+                if r.future.set_running_or_notify_cancel()
+            }
+            for req in ingests:
+                try:
+                    self.manager.submit(req.tenant, req.x, req.y)
+                    req.future.set_result(
+                        {"tenant": req.tenant, "points": int(req.x.shape[0])}
+                    )
+                except Exception as e:  # noqa: BLE001 — the error belongs to
+                    # the submitting client, not the shared dispatcher
+                    req.future.set_exception(e)
+            if scores:
+                self.fused_launch_cycles += 1
+                try:
+                    results = self.manager.score_many(
+                        {tid: req.x for tid, req in scores.items()}
+                    )
+                    for tid, req in scores.items():
+                        req.future.set_result(results[tid])
+                except Exception as e:  # noqa: BLE001
+                    for req in scores.values():
+                        if not req.future.done():
+                            req.future.set_exception(e)
+                    telemetry.flight_record(
+                        "frontend_error", error=repr(e)[:200],
+                        tenants=sorted(scores),
+                    )
+            if held:
+                self.held_ingest_cycles += 1
+            if not scores and not ingests:
+                # everything queued is held behind in-flight re-fits: poll
+                # for their touchdowns so held ingests eventually release
+                self.manager.poll()
+                time.sleep(self._idle_poll)

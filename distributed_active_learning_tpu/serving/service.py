@@ -1,139 +1,56 @@
-"""Streaming AL service: ingest-drain + resident scoring + drift-gated re-fit.
+"""Streaming AL service: the single-tenant front of the tenant manager.
 
-The batch drivers (runtime/loop.py, runtime/pipeline.py) already separate
-*dispatch* from *touchdown*: a fused chunk launches, the host keeps working,
-and the bookkeeping runs when the chunk's two stop scalars arrive. This
-module generalizes that discipline from "a fixed sequence of chunks" into a
-long-running event loop interleaving three work sources:
+PR 7 built this module as a self-contained event loop (ingest-drain +
+resident scoring + drift-gated re-fit over a slab-paged pool). PR 12 moved
+that loop VERBATIM into :class:`~serving.tenants.Tenant` so a multi-tenant
+manager (:class:`~serving.tenants.TenantManager`) can hold N of them —
+:class:`ALService` is now a thin compatibility wrapper routing through a
+1-tenant manager. There is exactly ONE event-loop implementation; this
+module only preserves the public single-tenant surface:
 
-- **Ingest.** Arrivals buffer host-side and drain into the slab-paged pool
-  (serving/slab.py) in fixed-width donation writes — the watermark advances,
-  no program recompiles, capacity grows slab-at-a-time when headroom runs
-  out.
+- the constructor signature, ``score``/``submit``/``flush``/``refit_now``/
+  ``save_checkpoint``/``summary``/``recompiles_after_warmup``;
+- the ``bench.py --mode serve`` key set (byte-compatible — the committed
+  ``benches/baselines/cpu_smoke_serve.json`` baseline and its CI gate
+  survive unchanged);
+- pre-multi-tenant serve checkpoints (the wrapper keeps the tenant-less
+  ``servestate_<round>.npz`` file names).
 
-- **Scoring.** ``score(points)`` serves from the RESIDENT fitted forest
-  through a fixed-width jitted program — the low-latency path. It never
-  touches the pool, so it stays hot while a re-fit chunk is in flight: the
-  old forest answers queries until the new one lands.
-
-- **Re-fit.** A drift monitor (serving/drift.py) watches the serve-time
-  entropy stream against the last chunk's in-scan RoundMetrics baseline and
-  dispatches a fused AL chunk (the SAME ``make_chunk_fn`` program the batch
-  driver runs, with the watermark riding as the dynamic ``n_filled`` leaf)
-  when the traffic drifts — not on a fixed cadence. The chunk's touchdown is
-  polled non-blockingly (``jax.Array.is_ready``) so scoring latency never
-  eats a chunk's device time.
-
-Donation choreography (the part that must not be improvised): the chunk
-donates its carried state, so the instant a re-fit dispatches, the slab
-rebinds to the chunk's OUTPUT arrays — ingest launched while the chunk is in
-flight consumes those futures and simply queues behind it on device. The
-binned ``codes`` ride outside the donated carry, so they survive the chunk
-and only ingest ever rewrites them.
-
-Single-process by design: multihost serving is the pod-sharding ROADMAP item;
-this module is the continuous-operation substrate it will serve through.
+What the wrapper ALSO inherits from the tenant core, for free: the AOT
+capacity precompile (slab growth swaps in background-compiled executables
+instead of paying XLA compile on the triggering request — the
+``slab_growth_compile`` p99 cause from PR 8 disappears post-warmup) and the
+tenant-tagged telemetry stream. See serving/tenants.py for the design and
+serving/frontend.py for the concurrent front queue.
 """
 
 from __future__ import annotations
 
-import dataclasses
-import time
 from typing import Dict, Optional
 
-import jax
-import jax.numpy as jnp
 import numpy as np
 
 from distributed_active_learning_tpu.config import ExperimentConfig, ServeConfig
-from distributed_active_learning_tpu.runtime import state as state_lib
-from distributed_active_learning_tpu.runtime import telemetry
-from distributed_active_learning_tpu.serving import drift as drift_lib
-from distributed_active_learning_tpu.serving import slab as slab_lib
-
-
-class _ProgramTracker:
-    """Per-program-instance launch accounting with a recompile COUNT.
-
-    Like :class:`~runtime.telemetry.LaunchTracker` (and it emits the same
-    ``launch`` JSONL events through the writer), but the recompile detection
-    runs with or without a writer and accumulates — the service's headline
-    ``recompiles_after_warmup`` is the sum over every program instance, and a
-    bench must be able to assert it at zero without a metrics file.
-    """
-
-    def __init__(self, writer, program: str, fn):
-        self.writer = writer
-        self.program = program
-        self.fn = fn
-        self.calls = 0
-        self.recompiles = 0
-        self._last_cache = None
-
-    def record(self, seconds: float, **extra) -> None:
-        self.calls += 1
-        cache = telemetry.jit_cache_size(self.fn)
-        recompiled = (
-            self.calls > 1
-            and cache is not None
-            and self._last_cache is not None
-            and cache > self._last_cache
-        )
-        if recompiled:
-            self.recompiles += 1
-            # A silent recompile is exactly the event a dead run's post-
-            # mortem needs; the score path's per-query launches stay out of
-            # the ring (they'd flush everything else) — recompiles don't.
-            telemetry.flight_record(
-                "recompile", program=self.program, call=self.calls,
-                cache_size=cache,
-            )
-        self._last_cache = cache
-        if self.writer is not None:
-            self.writer.launch(
-                self.program, seconds,
-                first_call=self.calls == 1,
-                cache_size=cache,
-                recompiled=recompiled,
-                **extra,
-            )
-
-
-@dataclasses.dataclass
-class _CapacityPrograms:
-    """The jitted programs specialized on one slab capacity."""
-
-    ingest: object
-    chunk: object
-    fit: object
-    ingest_tracker: _ProgramTracker
-    chunk_tracker: _ProgramTracker
-    fit_tracker: _ProgramTracker
-
-
-@dataclasses.dataclass
-class ServeStats:
-    """Host-side service counters (all plain ints — no device reads)."""
-
-    queries: int = 0
-    scored_points: int = 0
-    ingest_blocks: int = 0
-    ingested_points: int = 0
-    refits: int = 0
-    refit_rounds: int = 0
-    refits_skipped_fit_budget: int = 0
-    slab_growths: int = 0
+from distributed_active_learning_tpu.serving.tenants import (  # noqa: F401
+    ServeStats,
+    Tenant,
+    TenantManager,
+    _CapacityPrograms,
+    _ProgramTracker,
+)
 
 
 class ALService:
-    """The long-running service driver.
+    """The long-running single-tenant service driver (compatibility front).
 
     ``cfg`` supplies the model/strategy/seeding half (the same
     :class:`ExperimentConfig` the batch drivers take — ``forest.fit`` must be
     ``"device"``; the whole point is a resident device loop); ``serve``
     supplies the streaming knobs. ``train_x/train_y`` seed the pool (the
     service's cold-start corpus), ``test_x/test_y`` feed the chunk's accuracy
-    eval exactly as in the batch loop.
+    eval exactly as in the batch loop. Internally this is a
+    :class:`~serving.tenants.TenantManager` holding one tenant named
+    ``default`` — no duplicated event loop.
     """
 
     def __init__(
@@ -147,88 +64,9 @@ class ALService:
         metrics=None,
         checkpoint_dir: Optional[str] = None,
     ):
-        from distributed_active_learning_tpu.ops import trees_train
-        from distributed_active_learning_tpu.runtime.loop import build_aux
-        from distributed_active_learning_tpu.runtime.results import ExperimentResult
-        from distributed_active_learning_tpu.strategies import get_strategy
-
-        if cfg.forest.fit != "device":
-            raise ValueError(
-                "the streaming service needs ForestConfig.fit='device' — a "
-                "host sklearn fit cannot live inside the resident loop"
-            )
-        self.cfg = cfg
-        self.serve = serve
-        self.metrics = metrics
-        self.checkpoint_dir = checkpoint_dir
-        self.stats = ServeStats()
-        self.refit_reasons: Dict[str, int] = {}
-        self.result = ExperimentResult()
-
-        host_y = np.asarray(train_y, np.int32)
-        self.n_classes = max(int(host_y.max()) + 1, 2) if host_y.size else 2
-        self._strategy = get_strategy(cfg.strategy)
-
-        state0 = state_lib.init_pool_state(train_x, train_y, jax.random.key(cfg.seed))
-        state0 = state_lib.set_start_state(state0, cfg.n_start, n_classes=self.n_classes)
-        binned = trees_train.make_bins(jnp.asarray(state0.x), cfg.forest.max_bins)
-        self._edges = binned.edges
-        self._slab = slab_lib.init_slab_pool(
-            state0.x, state0.oracle_y, state0.labeled_mask,
-            self._edges, serve.slab_rows,
-        )
-        self._key = state0.key
-        self._round = state0.round
-        self._round_host = 0
-        self._fill = int(state0.x.shape[0])
-        self._labeled = int(state_lib.labeled_count(state0))
-        aux = build_aux(cfg, state0)
-        # The seed mask must track the SLAB arrays' capacity (strategies that
-        # consume it — density's non-seed mass, random's seed exclusion — dot
-        # it against capacity-sized pool vectors), and padding it here also
-        # makes it a fresh buffer the chunk's carry donation cannot alias
-        # (the same copy the batch driver does). Re-padded on every growth.
-        if aux.seed_mask is not None:
-            aux = aux.replace(seed_mask=self._pad_seed_mask(aux.seed_mask))
-        self._aux = aux
-        self._fit_key = jax.random.key(cfg.seed + 0x5EED)
-        self._test_x = jnp.asarray(test_x)
-        self._test_y = jnp.asarray(test_y)
-
-        # Labeled-window capacity of the device fit, FIXED across capacities
-        # so a grown pool reuses the same gather/fit shapes. Labels grow
-        # without bound in a service; the dispatch guard below refuses a
-        # chunk that could outgrow the window instead of silently truncating.
-        self._fit_budget = (
-            min(cfg.forest.fit_budget, self._slab.capacity)
-            if cfg.forest.fit_budget is not None
-            else serve.slab_rows
-        )
-        self._fit_budget_exhausted = False
-
-        self.drift = drift_lib.DriftMonitor(
-            entropy_shift=serve.drift_entropy_shift,
-            margin_shift=serve.drift_margin_shift,
-            min_fresh=serve.drift_min_fresh,
-            max_staleness=serve.max_staleness,
-        )
-
-        self._programs: Dict[int, _CapacityPrograms] = {}
-        self._score_fn = slab_lib.make_score_fn()
-        self._score_tracker = _ProgramTracker(metrics, "serve_score", self._score_fn)
-        self._ingest_buf_x: list = []
-        self._ingest_buf_y: list = []
-        self._inflight = None
-        self._inflight_polls = 0
-        # Concurrent-cause tags for the NEXT serve_latency event: slab
-        # growths and refit dispatches queue device work (and one-off
-        # compiles) that the following score query pays for as a latency
-        # spike — tagging the query with what ran beside it makes the serve
-        # bench's p99 attributable (summarize_metrics groups by cause).
-        self._latency_causes: set = set()
-
         if metrics is not None:
             from distributed_active_learning_tpu.config import asdict as cfg_asdict
+            import jax
 
             metrics.meta(
                 config=cfg_asdict(cfg),
@@ -236,417 +74,108 @@ class ALService:
                 backend=jax.default_backend(),
                 loop="serve",
             )
-
-        restored = False
-        if checkpoint_dir:
-            restored = self._try_restore(checkpoint_dir)
-        if not restored:
-            self._refresh_forest()
-
-    def _pad_seed_mask(self, mask) -> jnp.ndarray:
-        """Seed mask padded (False) to the current slab capacity — slab rows
-        past the cold-start pool were never seeded."""
-        pad = self._slab.capacity - mask.shape[0]
-        return jnp.pad(jnp.asarray(mask, bool), (0, pad))
-
-    # -- program cache -------------------------------------------------------
-
-    def _programs_for(self, capacity: int) -> _CapacityPrograms:
-        progs = self._programs.get(capacity)
-        if progs is not None:
-            return progs
-        from distributed_active_learning_tpu.runtime.loop import (
-            make_chunk_fn,
-            make_device_fit,
+        self.manager = TenantManager(metrics=metrics, checkpoint_dir=checkpoint_dir)
+        # ckpt_name=None keeps the PR-7 single-tenant checkpoint file names,
+        # so services started before the tenant axis existed keep resuming.
+        self._tenant = self.manager.add_tenant(
+            "default", cfg, serve, train_x, train_y, test_x, test_y,
+            ckpt_name=None,
         )
 
-        fit = make_device_fit(self.cfg, self._edges, self._fit_budget, self.n_classes)
-        chunk = make_chunk_fn(
-            self._strategy,
-            self.cfg.strategy.window_size,
-            self.serve.refit_rounds,
-            fit,
-            label_cap=capacity,
-            with_metrics=True,
-            n_classes=self.n_classes,
-        )
-        ingest = slab_lib.make_ingest_fn()
-        m = self.metrics
-        progs = _CapacityPrograms(
-            ingest=ingest,
-            chunk=chunk,
-            fit=fit,
-            ingest_tracker=_ProgramTracker(m, f"serve_ingest@{capacity}", ingest),
-            chunk_tracker=_ProgramTracker(m, f"serve_chunk@{capacity}", chunk),
-            fit_tracker=_ProgramTracker(m, f"serve_fit@{capacity}", fit),
-        )
-        self._programs[capacity] = progs
-        return progs
-
-    # -- the three work sources ---------------------------------------------
+    # -- the public endpoints (delegation, not reimplementation) -------------
 
     def score(self, queries) -> np.ndarray:
-        """Score query points against the resident forest (the endpoint).
-
-        Blocks only on ITS OWN batch's result — an in-flight re-fit chunk is
-        polled non-blockingly, so p99 scoring latency stays decoupled from
-        chunk wall time. Batches wider than the static ``score_width`` are
-        served in width-sized sub-batches.
-        """
-        q = np.asarray(queries, np.float32)
-        if q.ndim == 1:
-            q = q[None, :]
-        if q.shape[0] == 0:
-            return np.zeros((0,), np.float32)
-        width = self.serve.score_width
-        out = []
-        for lo in range(0, q.shape[0], width):
-            out.append(self._score_block(q[lo : lo + width]))
-        return np.concatenate(out) if len(out) > 1 else out[0]
-
-    def _score_block(self, q: np.ndarray) -> np.ndarray:
-        self._poll_refit()
-        n = q.shape[0]
-        pad = self.serve.score_width - n
-        qpad = np.pad(q, ((0, pad), (0, 0))) if pad else q
-        t0 = time.perf_counter()
-        scores, ent = self._score_fn(self._forest, jnp.asarray(qpad))
-        scores_np = np.asarray(scores)[:n]  # the one blocking fetch = latency
-        dt = time.perf_counter() - t0
-        self._score_tracker.record(dt, batch=n)
-        self.drift.observe_serve(float(np.mean(np.asarray(ent)[:n])))
-        self.stats.queries += 1
-        self.stats.scored_points += n
-        # The concurrent cause this query's latency is attributable to:
-        # a slab growth's one-per-new-capacity compile outranks an ordinary
-        # refit dispatch (both can be pending; the compile is the spike).
-        if "slab_growth_compile" in self._latency_causes:
-            cause = "slab_growth_compile"
-        elif "refit_dispatch" in self._latency_causes or self._inflight is not None:
-            cause = "refit_dispatch"
-        else:
-            cause = "none"
-        self._latency_causes.clear()
-        if self.metrics is not None:
-            self.metrics.event(
-                "serve_latency", seconds=round(dt, 6), batch=n,
-                inflight_refit=self._inflight is not None,
-                cause=cause,
-            )
-        self._maybe_refit()
-        return scores_np
+        return self._tenant.score(queries)
 
     def submit(self, x, y) -> None:
-        """Queue arriving points (with their eventual oracle labels — the
-        simulation convention the whole repo uses: labels exist but are
-        hidden until an AL round reveals them)."""
-        x = np.asarray(x, np.float32)
-        if x.ndim == 1:
-            x = x[None, :]
-        y = np.asarray(y, np.int32).reshape(-1)
-        # The class count is frozen at cold start (it sizes the fit's static
-        # shapes and the metrics histogram); a label past it would silently
-        # fall out of the histogram fit — refuse loudly instead.
-        if y.size and int(y.max()) >= self.n_classes:
-            raise ValueError(
-                f"ingested label {int(y.max())} is out of range for the "
-                f"service's {self.n_classes} classes (fixed by the cold-start "
-                "corpus); restart the service with a corpus covering every "
-                "class"
-            )
-        self._ingest_buf_x.append(x)
-        self._ingest_buf_y.append(y)
-        self._poll_refit()
-        self._drain_ingest()
-        self._maybe_refit()
+        self._tenant.submit(x, y)
 
     def flush(self) -> None:
-        """Drain any partial ingest block and force an in-flight re-fit's
-        touchdown — the quiesce point (checkpoint, shutdown, test barriers)."""
-        self._drain_ingest(force=True)
-        self._poll_refit(force=True)
-
-    # -- ingest --------------------------------------------------------------
-
-    def _drain_ingest(self, force: bool = False) -> None:
-        if not self._ingest_buf_x:
-            return
-        bx = np.concatenate(self._ingest_buf_x)
-        by = np.concatenate(self._ingest_buf_y)
-        block = self.serve.ingest_block
-        lo = 0
-        while bx.shape[0] - lo >= block:
-            self._ingest_block(bx[lo : lo + block], by[lo : lo + block], block)
-            lo += block
-        if force and lo < bx.shape[0]:
-            px, py, count = slab_lib.pad_block(bx[lo:], by[lo:], block)
-            self._ingest_block(px, py, count)
-            lo = bx.shape[0]
-        self._ingest_buf_x = [bx[lo:]] if lo < bx.shape[0] else []
-        self._ingest_buf_y = [by[lo:]] if lo < bx.shape[0] else []
-
-    def _ingest_block(self, bx: np.ndarray, by: np.ndarray, count: int) -> None:
-        block = self.serve.ingest_block
-        while self._fill + block > self._slab.capacity:
-            self._grow()
-        progs = self._programs_for(self._slab.capacity)
-        t0 = time.perf_counter()
-        self._slab, _fill_out = progs.ingest(
-            self._slab, self._edges,
-            jnp.asarray(bx), jnp.asarray(by), np.int32(count),
-        )
-        dt = time.perf_counter() - t0  # dispatch wall: the write is async
-        progs.ingest_tracker.record(dt, points=count)
-        self._fill += count
-        self.stats.ingest_blocks += 1
-        self.stats.ingested_points += count
-        self.drift.observe_ingest(count)
-        if self.metrics is not None:
-            self.metrics.event(
-                "ingest", points=count, seconds=round(dt, 6),
-                fill=self._fill, capacity=self._slab.capacity,
-            )
-
-    def _grow(self) -> None:
-        self._slab = slab_lib.grow_slab(self._slab)
-        if self._aux.seed_mask is not None:
-            self._aux = self._aux.replace(
-                seed_mask=self._pad_seed_mask(self._aux.seed_mask)
-            )
-        self.stats.slab_growths += 1
-        self._latency_causes.add("slab_growth_compile")
-        telemetry.flight_record(
-            "slab_grow", capacity=self._slab.capacity, fill=self._fill,
-            buffered=sum(len(b) for b in self._ingest_buf_x),
-        )
-        if self.metrics is not None:
-            self.metrics.event(
-                "slab_grow", capacity=self._slab.capacity, fill=self._fill
-            )
-
-    # -- re-fit --------------------------------------------------------------
-
-    def _maybe_refit(self) -> None:
-        if self._inflight is not None or self._fit_budget_exhausted:
-            return
-        reason = self.drift.should_refit()
-        if reason is None:
-            return
-        if self._fill - self._labeled <= 0:
-            return  # nothing left to label; a chunk would be all sentinels
-        K, window = self.serve.refit_rounds, self.cfg.strategy.window_size
-        if self._labeled + K * window > self._fit_budget:
-            # The device fit's labeled window is static; overrunning it would
-            # silently truncate the gather and corrupt the forest. Refuse
-            # loudly, once.
-            self._fit_budget_exhausted = True
-            self.stats.refits_skipped_fit_budget += 1
-            if self.metrics is not None:
-                self.metrics.event(
-                    "refit_skipped", reason="fit_budget",
-                    labeled=self._labeled, fit_budget=self._fit_budget,
-                )
-            return
-        self._dispatch_refit(reason)
+        self._tenant.flush()
 
     def refit_now(self, reason: str = "manual") -> bool:
-        """Dispatch a re-fit chunk immediately (warmup, operator request),
-        bypassing the drift decision but not the safety guards; returns
-        whether a chunk actually launched."""
-        if (
-            self._inflight is not None
-            or self._fit_budget_exhausted
-            or self._fill - self._labeled <= 0
-        ):
-            return False
-        K, window = self.serve.refit_rounds, self.cfg.strategy.window_size
-        if self._labeled + K * window > self._fit_budget:
-            return False
-        self._dispatch_refit(reason)
-        return True
-
-    def _dispatch_refit(self, reason: str) -> None:
-        progs = self._programs_for(self._slab.capacity)
-        state = slab_lib.flat_state(self._slab, self._key, self._round)
-        end_round = self._round_host + self.serve.refit_rounds
-        t0 = time.perf_counter()
-        out_state, extras, ys = progs.chunk(
-            self._slab.codes, state, self._aux, self._fit_key,
-            self._test_x, self._test_y, end_round,
-        )
-        # The chunk donated the carried state: rebind the slab to the output
-        # arrays NOW — every later ingest/score consumes these futures and
-        # sequences behind the running chunk on device.
-        self._slab = self._slab.replace(
-            x=out_state.x,
-            oracle_y=out_state.oracle_y,
-            labeled_mask=out_state.labeled_mask,
-            n_filled=out_state.n_filled,
-        )
-        self._key = out_state.key
-        self._round = out_state.round
-        self._inflight = (extras, ys, t0, reason, progs)
-        self._inflight_polls = 0
-        self.stats.refits += 1
-        self.refit_reasons[reason] = self.refit_reasons.get(reason, 0) + 1
-        self._latency_causes.add("refit_dispatch")
-        telemetry.flight_record(
-            "refit", reason=reason, rounds=self.serve.refit_rounds,
-            labeled=self._labeled, fill=self._fill,
-            capacity=self._slab.capacity,
-            buffered=sum(len(b) for b in self._ingest_buf_x),
-        )
-        if self.metrics is not None:
-            self.metrics.event(
-                "refit", reason=reason, rounds=self.serve.refit_rounds,
-                labeled=self._labeled, fill=self._fill,
-                capacity=self._slab.capacity,
-            )
-
-    def _poll_refit(self, force: bool = False) -> None:
-        if self._inflight is None:
-            return
-        extras = self._inflight[0]
-        self._inflight_polls += 1
-        ready = True
-        probe = getattr(extras.n_labeled_after, "is_ready", None)
-        if probe is not None and not force:
-            ready = bool(probe())
-        if force or ready or self._inflight_polls >= self.serve.refit_poll_events:
-            self._touchdown()
-
-    def _touchdown(self) -> None:
-        extras, ys, t0, reason, progs = self._inflight
-        self._inflight = None
-        n_labeled_after = int(extras.n_labeled_after)  # blocks if still running
-        n_active = int(extras.n_active)
-        dt = time.perf_counter() - t0
-        telemetry.flight_record(
-            "touchdown", program=progs.chunk_tracker.program, reason=reason,
-            n_active=n_active, n_labeled_after=n_labeled_after,
-            seconds=round(dt, 6), polls=self._inflight_polls,
-        )
-        progs.chunk_tracker.record(dt, reason=reason)
-        self._labeled = n_labeled_after
-        self._round_host += n_active
-        self.stats.refit_rounds += n_active
-        if n_active:
-            rounds_y, labeled_y, acc_y, _picked_y, active_y = ys[:5]
-            active_np = np.asarray(active_y)
-            rounds_np = np.asarray(rounds_y)[active_np]
-            labeled_np = np.asarray(labeled_y)[active_np]
-            acc_np = np.asarray(acc_y)[active_np]
-            round_dicts = telemetry.stacked_metrics_to_dicts(ys[5], active_np)
-            self.result.extend_from_arrays(
-                rounds_np, labeled_np,
-                np.maximum(self._fill - labeled_np, 0), acc_np,
-                total_time=dt / n_active,
-                metrics=round_dicts,
-            )
-            self.drift.observe_chunk(round_dicts)
-            if self.metrics is not None:
-                for i in range(n_active):
-                    self.metrics.round(
-                        round=int(rounds_np[i]),
-                        n_labeled=int(labeled_np[i]),
-                        accuracy=float(acc_np[i]),
-                        **round_dicts[i],
-                    )
-            self._refresh_forest()
-
-    def _refresh_forest(self) -> None:
-        """Re-fit the RESIDENT forest from the current labeled set — the
-        async launch whose output every subsequent score serves from."""
-        progs = self._programs_for(self._slab.capacity)
-        state = slab_lib.flat_state(self._slab, self._key, self._round)
-        t0 = time.perf_counter()
-        self._forest = progs.fit(
-            self._slab.codes, state,
-            jax.random.fold_in(self._fit_key, self._round_host),
-        )
-        progs.fit_tracker.record(time.perf_counter() - t0)
-
-    # -- persistence ---------------------------------------------------------
+        return self._tenant.refit_now(reason)
 
     def save_checkpoint(self) -> Optional[str]:
-        """Persist the slab watermark + mask + ingested points + resident
-        forest so a killed service resumes WITHOUT replaying ingest
-        (runtime/checkpoint.py ``save_serve``)."""
-        if not self.checkpoint_dir:
-            return None
-        from distributed_active_learning_tpu.runtime import checkpoint as ckpt_lib
-
-        self.flush()
-        state = slab_lib.flat_state(self._slab, self._key, self._round)
-        return ckpt_lib.save_serve(
-            self.checkpoint_dir, state, self._forest, self.result,
-            fingerprint=ckpt_lib.config_fingerprint(self.cfg),
-        )
-
-    def _try_restore(self, ckpt_dir: str) -> bool:
-        from distributed_active_learning_tpu.runtime import checkpoint as ckpt_lib
-
-        progs = self._programs_for(self._slab.capacity)
-        # The forest's pytree structure is whatever this configuration's fit
-        # program produces — eval_shape gives the template without running it.
-        template = jax.eval_shape(
-            progs.fit,
-            self._slab.codes,
-            slab_lib.flat_state(self._slab, self._key, self._round),
-            self._fit_key,
-        )
-        restored = ckpt_lib.restore_latest_serve(
-            ckpt_dir, template,
-            fingerprint=ckpt_lib.config_fingerprint(self.cfg),
-        )
-        if restored is None:
-            return False
-        x, y, mask, n_filled, key_data, rnd, forest, result = restored
-        self._slab = slab_lib.init_slab_pool(
-            x, y, mask, self._edges, self.serve.slab_rows
-        )
-        if self._aux.seed_mask is not None:
-            self._aux = self._aux.replace(
-                seed_mask=self._pad_seed_mask(self._aux.seed_mask)
-            )
-        self._fill = int(n_filled)
-        self._key = jax.random.wrap_key_data(
-            jnp.asarray(key_data), impl=jax.random.key_impl(self._key)
-        )
-        self._round = jnp.asarray(rnd)
-        self._round_host = int(rnd)
-        self._forest = forest
-        self.result = result
-        self._labeled = int(np.asarray(mask).sum())
-        return True
-
-    # -- reporting -----------------------------------------------------------
+        return self._tenant.save_checkpoint()
 
     def recompiles_after_warmup(self) -> int:
-        """Total jit-cache growths beyond each program instance's first call
-        — the no-silent-recompile guarantee the serve bench asserts at 0."""
-        total = self._score_tracker.recompiles
-        for progs in self._programs.values():
-            total += (
-                progs.ingest_tracker.recompiles
-                + progs.chunk_tracker.recompiles
-                + progs.fit_tracker.recompiles
-            )
-        return total
+        return self.manager.recompiles_after_warmup()
 
     def summary(self) -> Dict:
+        """The PR-7 key set, byte-compatible (bench.py --mode serve and its
+        committed baseline read these names)."""
+        t = self._tenant
         return {
-            "queries": self.stats.queries,
-            "scored_points": self.stats.scored_points,
-            "ingest_blocks": self.stats.ingest_blocks,
-            "ingested_points": self.stats.ingested_points,
-            "refits": self.stats.refits,
-            "refit_rounds": self.stats.refit_rounds,
-            "refit_reasons": dict(self.refit_reasons),
-            "refits_skipped_fit_budget": self.stats.refits_skipped_fit_budget,
-            "slab_growths": self.stats.slab_growths,
-            "capacity": self._slab.capacity,
-            "fill": self._fill,
-            "labeled": self._labeled,
+            "queries": t.stats.queries,
+            "scored_points": t.stats.scored_points,
+            "ingest_blocks": t.stats.ingest_blocks,
+            "ingested_points": t.stats.ingested_points,
+            "refits": t.stats.refits,
+            "refit_rounds": t.stats.refit_rounds,
+            "refit_reasons": dict(t.refit_reasons),
+            "refits_skipped_fit_budget": t.stats.refits_skipped_fit_budget,
+            "slab_growths": t.stats.slab_growths,
+            "capacity": t._slab.capacity,
+            "fill": t._fill,
+            "labeled": t._labeled,
             "recompiles_after_warmup": self.recompiles_after_warmup(),
         }
+
+    # -- state passthroughs (tests, __main__, and benches read these) --------
+
+    @property
+    def cfg(self) -> ExperimentConfig:
+        return self._tenant.cfg
+
+    @property
+    def serve(self) -> ServeConfig:
+        return self._tenant.serve
+
+    @property
+    def metrics(self):
+        return self._tenant.metrics
+
+    @property
+    def checkpoint_dir(self) -> Optional[str]:
+        return self._tenant.checkpoint_dir
+
+    @property
+    def stats(self) -> ServeStats:
+        return self._tenant.stats
+
+    @property
+    def refit_reasons(self) -> Dict[str, int]:
+        return self._tenant.refit_reasons
+
+    @property
+    def result(self):
+        return self._tenant.result
+
+    @property
+    def n_classes(self) -> int:
+        return self._tenant.n_classes
+
+    @property
+    def drift(self):
+        return self._tenant.drift
+
+    @property
+    def _slab(self):
+        return self._tenant._slab
+
+    @property
+    def _aux(self):
+        return self._tenant._aux
+
+    @property
+    def _fill(self) -> int:
+        return self._tenant._fill
+
+    @property
+    def _labeled(self) -> int:
+        return self._tenant._labeled
+
+    @property
+    def _forest(self):
+        return self._tenant._forest

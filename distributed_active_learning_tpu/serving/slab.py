@@ -193,6 +193,25 @@ def make_ingest_fn():
     return ingest
 
 
+def score_body(forest, queries: jnp.ndarray):
+    """The resident-forest scoring computation, shared by the single-tenant
+    endpoint (:func:`make_score_fn`) and the cross-tenant batched endpoint
+    (``serving/tenants.py make_batched_score_fn`` vmaps this over a leading
+    tenant axis). One traced body so the two paths cannot drift — the
+    batched-vs-independent bit-identity tests lean on it."""
+    from distributed_active_learning_tpu.ops import forest_eval, scoring, trees_multi
+
+    if trees_multi.is_multi(forest):
+        probs = trees_multi.proba_multi(forest, queries)
+        scores = jnp.max(probs, axis=-1)
+        ent = trees_multi.entropy_multi(probs)
+    else:
+        p = forest_eval.proba(forest, queries)
+        scores = p
+        ent = scoring.full_entropy(p)
+    return scores.astype(jnp.float32), ent.astype(jnp.float32)
+
+
 def make_score_fn():
     """Build the resident-forest scoring endpoint program.
 
@@ -203,20 +222,11 @@ def make_score_fn():
     pad), no pool dependence: one compile for the service's lifetime, and
     re-fitted forests of the same configuration reuse the executable.
     """
-    from distributed_active_learning_tpu.ops import forest_eval, scoring, trees_multi
 
     @jax.jit
     def score(forest, queries: jnp.ndarray):
         with jax.named_scope("serve/score"):
-            if trees_multi.is_multi(forest):
-                probs = trees_multi.proba_multi(forest, queries)
-                scores = jnp.max(probs, axis=-1)
-                ent = trees_multi.entropy_multi(probs)
-            else:
-                p = forest_eval.proba(forest, queries)
-                scores = p
-                ent = scoring.full_entropy(p)
-        return scores.astype(jnp.float32), ent.astype(jnp.float32)
+            return score_body(forest, queries)
 
     return score
 

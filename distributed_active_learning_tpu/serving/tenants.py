@@ -1,0 +1,1694 @@
+"""Multi-tenant AL-as-a-service: N resident forests on one process/mesh.
+
+PR 7's :class:`~serving.service.ALService` runs ONE dataset x model per
+process — fine for a demo, wrong for the north star ("heavy traffic from
+millions of users" means many tenants resident simultaneously). This module
+generalizes the single-tenant event loop into three load-bearing pieces:
+
+- **Tenant** — everything one resident (dataset x model) owns: its slab-paged
+  pool (serving/slab.py), drift monitor (serving/drift.py), per-capacity
+  program cache, resident fitted forest, stats/result/telemetry. The body is
+  the single-tenant service verbatim — :class:`~serving.service.ALService`
+  is now a thin wrapper over a 1-tenant manager, so there is exactly one
+  event-loop implementation.
+
+- **TenantManager** — N tenants on one device/mesh, plus the two cross-tenant
+  fused paths:
+
+  * **Batched scoring** (:meth:`TenantManager.score_many`): concurrent score
+    requests from different tenants coalesce into ONE launch —
+    :func:`make_batched_score_fn` vmaps the shared
+    :func:`~serving.slab.score_body` over a leading tenant axis. The tenant
+    axis is PADDED to the full resident set (absent tenants ride as zero-row
+    no-ops, per-tenant ``n_valid`` watermarks mask them out at unstack), so
+    request-subset churn never changes the program's avals — the same
+    discipline the slab pool applies to arrivals. Requires structurally
+    identical forests (same n_trees/depth/quantize/kernel); mismatches fall
+    back to per-tenant launches with a NAMED reason in the summary.
+
+  * **Batched re-fit** (tenant-axis chunk): when several same-configuration
+    tenants' drift monitors fire together, their re-fit chunks launch as ONE
+    program — the PR-9 grid chunk (``runtime/sweep.py make_grid_chunk_fn``)
+    with tenants riding the dataset axis (G=1 strategy group, D=T tenants,
+    E=1 seeds): per-tenant pools stack padded to the group's max capacity,
+    unequal fills ride the dynamic ``n_filled`` watermark, per-tenant
+    edges/test sets/budgets ride the per-cell inputs, and non-candidate
+    group members ride as masked no-ops (``end_round == round``) so the
+    program's tenant axis stays aval-stable. Outputs unstack per tenant at
+    touchdown. The grid chunk is bit-identical to serial cells (PR-9), so
+    batched tenants produce the SAME selections as independent services —
+    pinned by tests/test_serving_multi.py.
+
+- **AOT capacity precompile** — the known p99 spike: slab growth and the
+  first re-fit at a new capacity paid XLA compile on the triggering request
+  (the cause-tagged ``slab_growth_compile`` ``serve_latency`` events from
+  PR 8). A background worker thread now ``lower().compile()``s the NEXT
+  capacity's ingest/chunk/fit programs (and the tenant-axis chunk at the
+  group's next max capacity) before the watermark reaches the growth
+  threshold, so growth becomes an executable swap. An AOT executable also
+  CANNOT silently recompile — a mismatched aval raises — which is a strictly
+  stronger form of the ``recompiles_after_warmup == 0`` contract.
+
+Threading model: device work (score/ingest/chunk dispatch + touchdown) is
+assumed to run on ONE thread — the frontend (serving/frontend.py) funnels
+concurrent clients through its dispatcher; direct TenantManager calls from
+multiple threads must hold their own discipline. The precompile worker only
+builds executables and installs them under the manager lock; it never
+launches anything.
+"""
+
+from __future__ import annotations
+
+import atexit
+import dataclasses
+import queue as queue_lib
+import re
+import threading
+import time
+import weakref
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_active_learning_tpu.config import ExperimentConfig, ServeConfig
+from distributed_active_learning_tpu.runtime import state as state_lib
+from distributed_active_learning_tpu.runtime import telemetry
+from distributed_active_learning_tpu.serving import drift as drift_lib
+from distributed_active_learning_tpu.serving import slab as slab_lib
+
+_TENANT_ID_RE = re.compile(r"[A-Za-z0-9._-]+")
+
+# Killing a thread that is INSIDE an XLA compile at interpreter teardown
+# aborts the process ("terminate called without an active exception"), so
+# every manager's precompile worker registers here and atexit drains them
+# before the interpreter starts dying. WeakSet: a collected manager must not
+# be kept alive by its own shutdown hook.
+_LIVE_MANAGERS: "weakref.WeakSet[TenantManager]" = weakref.WeakSet()
+
+
+@atexit.register
+def _shutdown_precompile_workers() -> None:
+    for manager in list(_LIVE_MANAGERS):
+        manager.close()
+
+#: Eval kernels whose fitted forests stack/vmap cleanly over a tenant axis.
+#: "pallas" wraps the forest in a mesh-bound shard_map evaluator — per-tenant
+#: fallback with a named reason instead of a cryptic trace error.
+_BATCHABLE_KERNELS = ("gemm", "gather")
+
+
+class _ProgramTracker:
+    """Per-program-instance launch accounting with a recompile COUNT.
+
+    Like :class:`~runtime.telemetry.LaunchTracker` (and it emits the same
+    ``launch`` JSONL events through the writer), but the recompile detection
+    runs with or without a writer and accumulates — the service's headline
+    ``recompiles_after_warmup`` is the sum over every program instance, and a
+    bench must be able to assert it at zero without a metrics file. For an
+    AOT-compiled program ``jit_cache_size`` is unknowable (None) and the
+    count stays 0 — structurally true: an AOT executable cannot recompile,
+    a mismatched aval raises instead.
+    """
+
+    def __init__(self, writer, program: str, fn):
+        self.writer = writer
+        self.program = program
+        self.fn = fn
+        self.calls = 0
+        self.recompiles = 0
+        self._last_cache = None
+
+    def record(self, seconds: float, **extra) -> None:
+        self.calls += 1
+        cache = telemetry.jit_cache_size(self.fn)
+        recompiled = (
+            self.calls > 1
+            and cache is not None
+            and self._last_cache is not None
+            and cache > self._last_cache
+        )
+        if recompiled:
+            self.recompiles += 1
+            # A silent recompile is exactly the event a dead run's post-
+            # mortem needs; the score path's per-query launches stay out of
+            # the ring (they'd flush everything else) — recompiles don't.
+            telemetry.flight_record(
+                "recompile", program=self.program, call=self.calls,
+                cache_size=cache,
+            )
+        self._last_cache = cache
+        if self.writer is not None:
+            self.writer.launch(
+                self.program, seconds,
+                first_call=self.calls == 1,
+                cache_size=cache,
+                recompiled=recompiled,
+                **extra,
+            )
+
+
+@dataclasses.dataclass
+class _CapacityPrograms:
+    """The programs specialized on one slab capacity — jitted closures when
+    built lazily on the request path, AOT ``Compiled`` executables when the
+    precompile worker built them ahead of the growth threshold."""
+
+    ingest: object
+    chunk: object
+    fit: object
+    ingest_tracker: _ProgramTracker
+    chunk_tracker: _ProgramTracker
+    fit_tracker: _ProgramTracker
+    aot: bool = False
+
+
+@dataclasses.dataclass
+class ServeStats:
+    """Host-side per-tenant service counters (all plain ints — no device
+    reads)."""
+
+    queries: int = 0
+    scored_points: int = 0
+    ingest_blocks: int = 0
+    ingested_points: int = 0
+    refits: int = 0
+    refit_rounds: int = 0
+    refits_skipped_fit_budget: int = 0
+    slab_growths: int = 0
+    # Growths whose new-capacity programs were already resident (the AOT
+    # precompile landed in time) — the executable-swap fast path.
+    growths_precompiled: int = 0
+
+
+def _sds(shape, dtype) -> jax.ShapeDtypeStruct:
+    return jax.ShapeDtypeStruct(tuple(shape), dtype)
+
+
+def _aval(tree):
+    """Abstract twin of a concrete pytree (key arrays keep their extended
+    dtype) — what ``jit(...).lower`` consumes for AOT compilation."""
+    return jax.tree_util.tree_map(
+        lambda l: jax.ShapeDtypeStruct(tuple(l.shape), l.dtype), tree
+    )
+
+
+def make_batched_score_fn():
+    """Build the cross-tenant fused scoring program.
+
+    ``score(forests, queries[T, W, d]) -> (scores[T, W], entropy[T, W])`` —
+    :func:`~serving.slab.score_body` vmapped over a leading tenant axis, so
+    T tenants' concurrent queries cost ONE launch. ``forests`` is the
+    resident stacked forest (every leaf gains a leading ``[T]`` axis); the
+    tenant axis is static, so the program compiles once per resident-set
+    size, and per-call participation differences ride as padded rows the
+    caller masks at unstack (never as aval changes).
+    """
+
+    @jax.jit
+    def score(forests, queries: jnp.ndarray):
+        with jax.named_scope("serve/batched_score"):
+            return jax.vmap(slab_lib.score_body)(forests, queries)
+
+    return score
+
+
+class Tenant:
+    """One resident dataset x model: slab pool, drift monitor, per-capacity
+    programs, resident forest — the single-tenant event loop's whole state.
+
+    ``cfg`` supplies the model/strategy/seeding half (the same
+    :class:`ExperimentConfig` the batch drivers take — ``forest.fit`` must be
+    ``"device"``; the whole point is a resident device loop); ``serve``
+    supplies the streaming knobs. ``train_x/train_y`` seed the pool (the
+    tenant's cold-start corpus), ``test_x/test_y`` feed the chunk's accuracy
+    eval exactly as in the batch loop. ``ckpt_name`` is the tenant axis of
+    the serve checkpoint format (None keeps the single-tenant file names, so
+    pre-multi-tenant checkpoints keep resuming).
+    """
+
+    def __init__(
+        self,
+        tenant_id: str,
+        cfg: ExperimentConfig,
+        serve: ServeConfig,
+        train_x,
+        train_y,
+        test_x,
+        test_y,
+        metrics=None,
+        checkpoint_dir: Optional[str] = None,
+        ckpt_name: Optional[str] = None,
+        manager: Optional["TenantManager"] = None,
+    ):
+        from distributed_active_learning_tpu.ops import trees_train
+        from distributed_active_learning_tpu.runtime.loop import build_aux
+        from distributed_active_learning_tpu.runtime.results import ExperimentResult
+        from distributed_active_learning_tpu.strategies import get_strategy
+
+        if cfg.forest.fit != "device":
+            raise ValueError(
+                "the streaming service needs ForestConfig.fit='device' — a "
+                "host sklearn fit cannot live inside the resident loop"
+            )
+        self.tenant_id = tenant_id
+        self.cfg = cfg
+        self.serve = serve
+        self.metrics = metrics
+        self.checkpoint_dir = checkpoint_dir
+        self._ckpt_name = ckpt_name
+        self._manager = manager
+        self.stats = ServeStats()
+        self.refit_reasons: Dict[str, int] = {}
+        self.result = ExperimentResult()
+        # Post-warmup latency-cause table: how many serve_latency events each
+        # concurrent cause was tagged with. mark_warmup_complete() zeroes it;
+        # the serve-multi bench gate asserts slab_growth_compile stays absent
+        # afterwards (the AOT precompile's acceptance criterion).
+        self.cause_counts: Dict[str, int] = {}
+
+        host_y = np.asarray(train_y, np.int32)
+        self.n_classes = max(int(host_y.max()) + 1, 2) if host_y.size else 2
+        self._strategy = get_strategy(cfg.strategy)
+
+        state0 = state_lib.init_pool_state(train_x, train_y, jax.random.key(cfg.seed))
+        state0 = state_lib.set_start_state(state0, cfg.n_start, n_classes=self.n_classes)
+        binned = trees_train.make_bins(jnp.asarray(state0.x), cfg.forest.max_bins)
+        self._edges = binned.edges
+        self._slab = slab_lib.init_slab_pool(
+            state0.x, state0.oracle_y, state0.labeled_mask,
+            self._edges, serve.slab_rows,
+        )
+        self._key = state0.key
+        self._round = state0.round
+        self._round_host = 0
+        self._fill = int(state0.x.shape[0])
+        self._labeled = int(state_lib.labeled_count(state0))
+        aux = build_aux(cfg, state0)
+        # The seed mask must track the SLAB arrays' capacity (strategies that
+        # consume it — density's non-seed mass, random's seed exclusion — dot
+        # it against capacity-sized pool vectors), and padding it here also
+        # makes it a fresh buffer the chunk's carry donation cannot alias
+        # (the same copy the batch driver does). Re-padded on every growth.
+        if aux.seed_mask is not None:
+            aux = aux.replace(seed_mask=self._pad_seed_mask(aux.seed_mask))
+        self._aux = aux
+        self._fit_key = jax.random.key(cfg.seed + 0x5EED)
+        self._test_x = jnp.asarray(test_x)
+        self._test_y = jnp.asarray(test_y)
+
+        # Labeled-window capacity of the device fit, FIXED across capacities
+        # so a grown pool reuses the same gather/fit shapes. Labels grow
+        # without bound in a service; the dispatch guard below refuses a
+        # chunk that could outgrow the window instead of silently truncating.
+        self._fit_budget = (
+            min(cfg.forest.fit_budget, self._slab.capacity)
+            if cfg.forest.fit_budget is not None
+            else serve.slab_rows
+        )
+        self._fit_budget_exhausted = False
+
+        self.drift = drift_lib.DriftMonitor(
+            entropy_shift=serve.drift_entropy_shift,
+            margin_shift=serve.drift_margin_shift,
+            min_fresh=serve.drift_min_fresh,
+            max_staleness=serve.max_staleness,
+        )
+
+        self._programs: Dict[int, _CapacityPrograms] = {}
+        self._programs_lock = threading.Lock()
+        self._score_fn = slab_lib.make_score_fn()
+        self._score_tracker = _ProgramTracker(
+            metrics, f"serve_score@{tenant_id}", self._score_fn
+        )
+        self._ingest_buf_x: list = []
+        self._ingest_buf_y: list = []
+        # A single-tenant in-flight re-fit is the (extras, ys, t0, reason,
+        # progs) tuple; a tenant-axis batched re-fit is the shared
+        # _BatchedRefit whose touchdown updates every participant.
+        self._inflight = None
+        self._inflight_polls = 0
+        # Concurrent-cause tags for the NEXT serve_latency event: slab
+        # growths and refit dispatches queue device work (and one-off
+        # compiles) that the following score query pays for as a latency
+        # spike — tagging the query with what ran beside it makes the serve
+        # bench's p99 attributable (summarize_metrics groups by cause).
+        self._latency_causes: set = set()
+
+        restored = False
+        if checkpoint_dir:
+            restored = self._try_restore(checkpoint_dir)
+        if not restored:
+            self._refresh_forest()
+        # The batched score path needs structurally identical forests across
+        # tenants; the signature is capacity-independent (the fit window is
+        # fixed), so computing it once here is safe across growths.
+        self._forest_sig = (
+            str(jax.tree_util.tree_structure(self._forest)),
+            tuple(
+                (tuple(l.shape), str(l.dtype))
+                for l in jax.tree_util.tree_leaves(self._forest)
+            ),
+        )
+
+    # -- identity ------------------------------------------------------------
+
+    def _pad_seed_mask(self, mask) -> jnp.ndarray:
+        """Seed mask padded (False) to the current slab capacity — slab rows
+        past the cold-start pool were never seeded."""
+        pad = self._slab.capacity - mask.shape[0]
+        return jnp.pad(jnp.asarray(mask, bool), (0, pad))
+
+    def _chunk_signature(self) -> tuple:
+        """The program-shape identity a tenant-axis batched re-fit groups on:
+        tenants whose chunks would trace to the same per-cell body (strategy,
+        window, forest dims, fused round count, fit window, class count,
+        feature width) may share one grid-chunk launch."""
+        fc = self.cfg.forest
+        return (
+            self.cfg.strategy.name,
+            self.cfg.strategy.window_size,
+            tuple(sorted((k, str(v)) for k, v in self.cfg.strategy.options.items())),
+            fc.n_trees, fc.max_depth, fc.max_bins, fc.kernel, fc.quantize,
+            self.serve.refit_rounds,
+            self.n_classes,
+            self._fit_budget,
+            int(self._slab.x.shape[1]),
+            self.serve.slab_rows,
+        )
+
+    def _batchable_refit_reason(self) -> Optional[str]:
+        """None if this tenant's re-fit chunk may ride a tenant-axis batched
+        launch; a named reason otherwise (per-tenant dispatch fallback)."""
+        if self._aux.lal_forest is not None:
+            return "lal_forest"  # the grid takes ONE regressor per group
+        if self.cfg.forest.kernel not in _BATCHABLE_KERNELS:
+            return f"kernel:{self.cfg.forest.kernel}"
+        return None
+
+    @property
+    def refit_inflight(self) -> bool:
+        return self._inflight is not None
+
+    # -- program cache -------------------------------------------------------
+
+    def _build_programs(self, capacity: int, aot: bool = False) -> _CapacityPrograms:
+        """Assemble (and for ``aot`` compile) one capacity's program set.
+
+        The lazy request path builds jitted closures exactly as PR 7 did; the
+        precompile worker calls with ``aot=True`` to ``lower().compile()``
+        against the capacity's avals — same traced bodies, so the two paths
+        cannot diverge (pinned bit-identical in tests/test_serving_multi.py).
+        """
+        from distributed_active_learning_tpu.runtime.loop import (
+            make_chunk_fn,
+            make_device_fit,
+        )
+
+        fit = make_device_fit(self.cfg, self._edges, self._fit_budget, self.n_classes)
+        chunk = make_chunk_fn(
+            self._strategy,
+            self.cfg.strategy.window_size,
+            self.serve.refit_rounds,
+            fit,
+            label_cap=capacity,
+            with_metrics=True,
+            n_classes=self.n_classes,
+        )
+        ingest = slab_lib.make_ingest_fn()
+        if aot:
+            d = int(self._slab.x.shape[1])
+            key_aval = _aval(self._key)
+            slab_aval = slab_lib.SlabPool(
+                x=_sds((capacity, d), jnp.float32),
+                oracle_y=_sds((capacity,), jnp.int32),
+                labeled_mask=_sds((capacity,), jnp.bool_),
+                codes=_sds((capacity, d), jnp.int32),
+                n_filled=_sds((), jnp.int32),
+                slab_rows=self.serve.slab_rows,
+            )
+            state_aval = state_lib.PoolState(
+                x=_sds((capacity, d), jnp.float32),
+                oracle_y=_sds((capacity,), jnp.int32),
+                labeled_mask=_sds((capacity,), jnp.bool_),
+                key=key_aval,
+                round=_sds((), jnp.int32),
+                n_filled=_sds((), jnp.int32),
+            )
+            aux_aval = _aval(self._aux)
+            if self._aux.seed_mask is not None:
+                aux_aval = aux_aval.replace(
+                    seed_mask=_sds((capacity,), jnp.bool_)
+                )
+            edges_aval = _aval(self._edges)
+            ingest = ingest.lower(
+                slab_aval, edges_aval,
+                _sds((self.serve.ingest_block, d), jnp.float32),
+                _sds((self.serve.ingest_block,), jnp.int32),
+                _sds((), jnp.int32),
+            ).compile()
+            chunk = chunk.lower(
+                _sds((capacity, d), jnp.int32), state_aval, aux_aval,
+                _aval(self._fit_key), _aval(self._test_x), _aval(self._test_y),
+                _sds((), jnp.int32),
+            ).compile()
+            fit = fit.lower(
+                _sds((capacity, d), jnp.int32), state_aval, _aval(self._fit_key)
+            ).compile()
+        m = self.metrics
+        tid = self.tenant_id
+        return _CapacityPrograms(
+            ingest=ingest,
+            chunk=chunk,
+            fit=fit,
+            ingest_tracker=_ProgramTracker(m, f"serve_ingest@{tid}@{capacity}", ingest),
+            chunk_tracker=_ProgramTracker(m, f"serve_chunk@{tid}@{capacity}", chunk),
+            fit_tracker=_ProgramTracker(m, f"serve_fit@{tid}@{capacity}", fit),
+            aot=aot,
+        )
+
+    def _programs_for(self, capacity: int) -> _CapacityPrograms:
+        with self._programs_lock:
+            progs = self._programs.get(capacity)
+        if progs is not None:
+            return progs
+        progs = self._build_programs(capacity)
+        with self._programs_lock:
+            # the precompile worker may have landed meanwhile: its AOT set wins
+            return self._programs.setdefault(capacity, progs)
+
+    def _install_programs(self, capacity: int, progs: _CapacityPrograms) -> bool:
+        with self._programs_lock:
+            if capacity in self._programs:
+                return False
+            self._programs[capacity] = progs
+            return True
+
+    def _schedule_precompile(self) -> None:
+        """Hand the NEXT capacity to the precompile worker once the watermark
+        is within the headroom threshold of the current capacity."""
+        if self._manager is None or not self.serve.precompile_ahead:
+            return
+        headroom = int(self.serve.precompile_headroom_slabs * self.serve.slab_rows)
+        if self._slab.capacity - self._fill <= headroom:
+            self._manager.schedule_precompile(
+                self, self._slab.capacity + self.serve.slab_rows
+            )
+
+    # -- the three work sources ---------------------------------------------
+
+    def score(self, queries) -> np.ndarray:
+        """Score query points against the resident forest (the endpoint).
+
+        Blocks only on ITS OWN batch's result — an in-flight re-fit chunk is
+        polled non-blockingly, so p99 scoring latency stays decoupled from
+        chunk wall time. Batches wider than the static ``score_width`` are
+        served in width-sized sub-batches.
+        """
+        q = np.asarray(queries, np.float32)
+        if q.ndim == 1:
+            q = q[None, :]
+        if q.shape[0] == 0:
+            return np.zeros((0,), np.float32)
+        width = self.serve.score_width
+        out = []
+        for lo in range(0, q.shape[0], width):
+            out.append(self._score_block(q[lo : lo + width]))
+        return np.concatenate(out) if len(out) > 1 else out[0]
+
+    def _score_block(self, q: np.ndarray) -> np.ndarray:
+        self._poll_refit()
+        n = q.shape[0]
+        pad = self.serve.score_width - n
+        qpad = np.pad(q, ((0, pad), (0, 0))) if pad else q
+        t0 = time.perf_counter()
+        scores, ent = self._score_fn(self._forest, jnp.asarray(qpad))
+        scores_np = np.asarray(scores)[:n]  # the one blocking fetch = latency
+        dt = time.perf_counter() - t0
+        self._score_tracker.record(dt, batch=n)
+        self._finish_query(dt, n, float(np.mean(np.asarray(ent)[:n])))
+        self._maybe_refit()
+        return scores_np
+
+    def _finish_query(
+        self, dt: float, n: int, mean_entropy: float, batched: bool = False
+    ) -> None:
+        """Post-launch per-query bookkeeping shared by the single-tenant and
+        cross-tenant-batched score paths: drift observation, stats, and the
+        cause-tagged ``serve_latency`` event."""
+        self.drift.observe_serve(mean_entropy)
+        self.stats.queries += 1
+        self.stats.scored_points += n
+        # The concurrent cause this query's latency is attributable to:
+        # a slab growth's one-per-new-capacity compile outranks an ordinary
+        # refit dispatch (both can be pending; the compile is the spike).
+        if "slab_growth_compile" in self._latency_causes:
+            cause = "slab_growth_compile"
+        elif "refit_dispatch" in self._latency_causes or self._inflight is not None:
+            cause = "refit_dispatch"
+        else:
+            cause = "none"
+        self._latency_causes.clear()
+        self.cause_counts[cause] = self.cause_counts.get(cause, 0) + 1
+        if self.metrics is not None:
+            self.metrics.event(
+                "serve_latency", tenant=self.tenant_id,
+                seconds=round(dt, 6), batch=n,
+                inflight_refit=self._inflight is not None,
+                cause=cause,
+                batched=batched,
+            )
+
+    def submit(self, x, y) -> None:
+        """Queue arriving points (with their eventual oracle labels — the
+        simulation convention the whole repo uses: labels exist but are
+        hidden until an AL round reveals them)."""
+        x = np.asarray(x, np.float32)
+        if x.ndim == 1:
+            x = x[None, :]
+        y = np.asarray(y, np.int32).reshape(-1)
+        # The class count is frozen at cold start (it sizes the fit's static
+        # shapes and the metrics histogram); a label past it would silently
+        # fall out of the histogram fit — refuse loudly instead.
+        if y.size and int(y.max()) >= self.n_classes:
+            raise ValueError(
+                f"ingested label {int(y.max())} is out of range for the "
+                f"service's {self.n_classes} classes (fixed by the cold-start "
+                "corpus); restart the service with a corpus covering every "
+                "class"
+            )
+        self._ingest_buf_x.append(x)
+        self._ingest_buf_y.append(y)
+        self._poll_refit()
+        self._drain_ingest()
+        self._maybe_refit()
+
+    def flush(self) -> None:
+        """Drain any partial ingest block and force an in-flight re-fit's
+        touchdown — the quiesce point (checkpoint, shutdown, test barriers)."""
+        self._drain_ingest(force=True)
+        self._poll_refit(force=True)
+
+    # -- ingest --------------------------------------------------------------
+
+    def _drain_ingest(self, force: bool = False) -> None:
+        if not self._ingest_buf_x:
+            return
+        bx = np.concatenate(self._ingest_buf_x)
+        by = np.concatenate(self._ingest_buf_y)
+        block = self.serve.ingest_block
+        lo = 0
+        while bx.shape[0] - lo >= block:
+            self._ingest_block(bx[lo : lo + block], by[lo : lo + block], block)
+            lo += block
+        if force and lo < bx.shape[0]:
+            px, py, count = slab_lib.pad_block(bx[lo:], by[lo:], block)
+            self._ingest_block(px, py, count)
+            lo = bx.shape[0]
+        self._ingest_buf_x = [bx[lo:]] if lo < bx.shape[0] else []
+        self._ingest_buf_y = [by[lo:]] if lo < bx.shape[0] else []
+
+    def _ingest_block(self, bx: np.ndarray, by: np.ndarray, count: int) -> None:
+        block = self.serve.ingest_block
+        while self._fill + block > self._slab.capacity:
+            self._grow()
+        progs = self._programs_for(self._slab.capacity)
+        t0 = time.perf_counter()
+        self._slab, _fill_out = progs.ingest(
+            self._slab, self._edges,
+            jnp.asarray(bx), jnp.asarray(by), jnp.asarray(count, jnp.int32),
+        )
+        dt = time.perf_counter() - t0  # dispatch wall: the write is async
+        progs.ingest_tracker.record(dt, points=count)
+        self._fill += count
+        self.stats.ingest_blocks += 1
+        self.stats.ingested_points += count
+        self.drift.observe_ingest(count)
+        if self.metrics is not None:
+            self.metrics.event(
+                "ingest", tenant=self.tenant_id,
+                points=count, seconds=round(dt, 6),
+                fill=self._fill, capacity=self._slab.capacity,
+            )
+        self._schedule_precompile()
+
+    def _grow(self) -> None:
+        self._slab = slab_lib.grow_slab(self._slab)
+        if self._aux.seed_mask is not None:
+            self._aux = self._aux.replace(
+                seed_mask=self._pad_seed_mask(self._aux.seed_mask)
+            )
+        self.stats.slab_growths += 1
+        cap = self._slab.capacity
+        with self._programs_lock:
+            ready = cap in self._programs
+        if not ready and self._manager is not None:
+            # A precompile may be mid-flight: wait for it rather than racing
+            # a second compile of the same programs on the request thread.
+            # The wait is still a growth stall, so the cause tag stands
+            # (ready stays False for the accounting below).
+            self._manager.wait_precompile(self, cap)
+        if ready:
+            self.stats.growths_precompiled += 1
+        else:
+            self._latency_causes.add("slab_growth_compile")
+        telemetry.flight_record(
+            "slab_grow", tenant=self.tenant_id,
+            capacity=cap, fill=self._fill,
+            buffered=sum(len(b) for b in self._ingest_buf_x),
+            precompiled=ready,
+        )
+        if self.metrics is not None:
+            self.metrics.event(
+                "slab_grow", tenant=self.tenant_id,
+                capacity=cap, fill=self._fill, precompiled=ready,
+            )
+        self._schedule_precompile()
+
+    # -- re-fit --------------------------------------------------------------
+
+    def _refit_candidate(self) -> Optional[str]:
+        """The drift decision plus every dispatch guard, WITHOUT dispatching:
+        the manager collects candidates across tenants so coinciding re-fits
+        batch into one tenant-axis launch. Returns the reason, or None."""
+        if self._inflight is not None or self._fit_budget_exhausted:
+            return None
+        reason = self.drift.should_refit()
+        if reason is None:
+            return None
+        return self._check_refit_guards(reason)
+
+    def _check_refit_guards(self, reason: str) -> Optional[str]:
+        if self._fill - self._labeled <= 0:
+            return None  # nothing left to label; a chunk would be all sentinels
+        K, window = self.serve.refit_rounds, self.cfg.strategy.window_size
+        if self._labeled + K * window > self._fit_budget:
+            # The device fit's labeled window is static; overrunning it would
+            # silently truncate the gather and corrupt the forest. Refuse
+            # loudly, once.
+            self._fit_budget_exhausted = True
+            self.stats.refits_skipped_fit_budget += 1
+            if self.metrics is not None:
+                self.metrics.event(
+                    "refit_skipped", tenant=self.tenant_id, reason="fit_budget",
+                    labeled=self._labeled, fit_budget=self._fit_budget,
+                )
+            return None
+        return reason
+
+    def _maybe_refit(self) -> None:
+        if self._manager is not None:
+            self._manager._maybe_refit_group()
+            return
+        reason = self._refit_candidate()
+        if reason is not None:
+            self._dispatch_refit(reason)
+
+    def refit_now(self, reason: str = "manual") -> bool:
+        """Dispatch a re-fit chunk immediately (warmup, operator request),
+        bypassing the drift decision but not the safety guards; returns
+        whether a chunk actually launched."""
+        if self._inflight is not None or self._fit_budget_exhausted:
+            return False
+        if self._check_refit_guards(reason) is None:
+            return False
+        self._dispatch_refit(reason)
+        return True
+
+    def _record_refit_dispatch(self, reason: str) -> None:
+        self.stats.refits += 1
+        self.refit_reasons[reason] = self.refit_reasons.get(reason, 0) + 1
+        self._latency_causes.add("refit_dispatch")
+        telemetry.flight_record(
+            "refit", tenant=self.tenant_id,
+            reason=reason, rounds=self.serve.refit_rounds,
+            labeled=self._labeled, fill=self._fill,
+            capacity=self._slab.capacity,
+            buffered=sum(len(b) for b in self._ingest_buf_x),
+        )
+        if self.metrics is not None:
+            self.metrics.event(
+                "refit", tenant=self.tenant_id,
+                reason=reason, rounds=self.serve.refit_rounds,
+                labeled=self._labeled, fill=self._fill,
+                capacity=self._slab.capacity,
+            )
+
+    def _dispatch_refit(self, reason: str) -> None:
+        progs = self._programs_for(self._slab.capacity)
+        state = slab_lib.flat_state(self._slab, self._key, self._round)
+        end_round = self._round_host + self.serve.refit_rounds
+        t0 = time.perf_counter()
+        out_state, extras, ys = progs.chunk(
+            self._slab.codes, state, self._aux, self._fit_key,
+            self._test_x, self._test_y, jnp.asarray(end_round, jnp.int32),
+        )
+        # The chunk donated the carried state: rebind the slab to the output
+        # arrays NOW — every later ingest/score consumes these futures and
+        # sequences behind the running chunk on device.
+        self._slab = self._slab.replace(
+            x=out_state.x,
+            oracle_y=out_state.oracle_y,
+            labeled_mask=out_state.labeled_mask,
+            n_filled=out_state.n_filled,
+        )
+        self._key = out_state.key
+        self._round = out_state.round
+        self._inflight = (extras, ys, t0, reason, progs)
+        self._inflight_polls = 0
+        self._record_refit_dispatch(reason)
+
+    def _poll_refit(self, force: bool = False) -> None:
+        if self._inflight is None:
+            return
+        if isinstance(self._inflight, _BatchedRefit):
+            self._inflight.poll(force=force)
+            return
+        extras = self._inflight[0]
+        self._inflight_polls += 1
+        ready = True
+        probe = getattr(extras.n_labeled_after, "is_ready", None)
+        if probe is not None and not force:
+            ready = bool(probe())
+        if force or ready or self._inflight_polls >= self.serve.refit_poll_events:
+            self._touchdown()
+
+    def _touchdown(self) -> None:
+        extras, ys, t0, reason, progs = self._inflight
+        self._inflight = None
+        n_labeled_after = int(extras.n_labeled_after)  # blocks if still running
+        n_active = int(extras.n_active)
+        dt = time.perf_counter() - t0
+        telemetry.flight_record(
+            "touchdown", tenant=self.tenant_id,
+            program=progs.chunk_tracker.program, reason=reason,
+            n_active=n_active, n_labeled_after=n_labeled_after,
+            seconds=round(dt, 6), polls=self._inflight_polls,
+        )
+        progs.chunk_tracker.record(dt, reason=reason)
+        self._labeled = n_labeled_after
+        self._round_host += n_active
+        self.stats.refit_rounds += n_active
+        if n_active:
+            rounds_y, labeled_y, acc_y, _picked_y, active_y = ys[:5]
+            active_np = np.asarray(active_y)
+            rounds_np = np.asarray(rounds_y)[active_np]
+            labeled_np = np.asarray(labeled_y)[active_np]
+            acc_np = np.asarray(acc_y)[active_np]
+            round_dicts = telemetry.stacked_metrics_to_dicts(ys[5], active_np)
+            self._absorb_rounds(rounds_np, labeled_np, acc_np, round_dicts, dt / n_active)
+
+    def _absorb_rounds(
+        self, rounds_np, labeled_np, acc_np, round_dicts, per_round_seconds
+    ) -> None:
+        """Fold one touchdown's active rounds into records/drift/metrics and
+        refresh the resident forest — shared by the single-tenant and the
+        tenant-axis batched touchdown paths."""
+        self.result.extend_from_arrays(
+            rounds_np, labeled_np,
+            np.maximum(self._fill - labeled_np, 0), acc_np,
+            total_time=per_round_seconds,
+            metrics=round_dicts,
+        )
+        self.drift.observe_chunk(round_dicts)
+        if self.metrics is not None:
+            for i in range(len(rounds_np)):
+                self.metrics.round(
+                    tenant=self.tenant_id,
+                    round=int(rounds_np[i]),
+                    n_labeled=int(labeled_np[i]),
+                    accuracy=float(acc_np[i]),
+                    **round_dicts[i],
+                )
+        self._refresh_forest()
+
+    def _refresh_forest(self) -> None:
+        """Re-fit the RESIDENT forest from the current labeled set — the
+        async launch whose output every subsequent score serves from."""
+        progs = self._programs_for(self._slab.capacity)
+        state = slab_lib.flat_state(self._slab, self._key, self._round)
+        t0 = time.perf_counter()
+        self._forest = progs.fit(
+            self._slab.codes, state,
+            jax.random.fold_in(self._fit_key, self._round_host),
+        )
+        progs.fit_tracker.record(time.perf_counter() - t0)
+        if self._manager is not None:
+            self._manager._mark_forest_dirty()
+
+    # -- persistence ---------------------------------------------------------
+
+    def save_checkpoint(self) -> Optional[str]:
+        """Persist the slab watermark + mask + ingested points + resident
+        forest so a killed service resumes WITHOUT replaying ingest
+        (runtime/checkpoint.py ``save_serve``, tenant-axis file names when
+        this tenant rides a multi-tenant manager)."""
+        if not self.checkpoint_dir:
+            return None
+        from distributed_active_learning_tpu.runtime import checkpoint as ckpt_lib
+
+        self.flush()
+        state = slab_lib.flat_state(self._slab, self._key, self._round)
+        return ckpt_lib.save_serve(
+            self.checkpoint_dir, state, self._forest, self.result,
+            fingerprint=ckpt_lib.config_fingerprint(self.cfg),
+            tenant=self._ckpt_name,
+        )
+
+    def _try_restore(self, ckpt_dir: str) -> bool:
+        from distributed_active_learning_tpu.runtime import checkpoint as ckpt_lib
+
+        progs = self._programs_for(self._slab.capacity)
+        # The forest's pytree structure is whatever this configuration's fit
+        # program produces — eval_shape gives the template without running it.
+        template = jax.eval_shape(
+            progs.fit,
+            self._slab.codes,
+            slab_lib.flat_state(self._slab, self._key, self._round),
+            self._fit_key,
+        )
+        restored = ckpt_lib.restore_latest_serve(
+            ckpt_dir, template,
+            fingerprint=ckpt_lib.config_fingerprint(self.cfg),
+            tenant=self._ckpt_name,
+        )
+        if restored is None:
+            return False
+        x, y, mask, n_filled, key_data, rnd, forest, result = restored
+        self._slab = slab_lib.init_slab_pool(
+            x, y, mask, self._edges, self.serve.slab_rows
+        )
+        if self._aux.seed_mask is not None:
+            self._aux = self._aux.replace(
+                seed_mask=self._pad_seed_mask(self._aux.seed_mask)
+            )
+        self._fill = int(n_filled)
+        self._key = jax.random.wrap_key_data(
+            jnp.asarray(key_data), impl=jax.random.key_impl(self._key)
+        )
+        self._round = jnp.asarray(rnd)
+        self._round_host = int(rnd)
+        self._forest = forest
+        self.result = result
+        self._labeled = int(np.asarray(mask).sum())
+        return True
+
+    # -- reporting -----------------------------------------------------------
+
+    def recompiles_after_warmup(self) -> int:
+        """Total jit-cache growths beyond each program instance's first call
+        — the no-silent-recompile guarantee the serve bench asserts at 0."""
+        total = self._score_tracker.recompiles
+        with self._programs_lock:
+            progs_list = list(self._programs.values())
+        for progs in progs_list:
+            total += (
+                progs.ingest_tracker.recompiles
+                + progs.chunk_tracker.recompiles
+                + progs.fit_tracker.recompiles
+            )
+        return total
+
+    def summary(self) -> Dict:
+        return {
+            "tenant": self.tenant_id,
+            "queries": self.stats.queries,
+            "scored_points": self.stats.scored_points,
+            "ingest_blocks": self.stats.ingest_blocks,
+            "ingested_points": self.stats.ingested_points,
+            "refits": self.stats.refits,
+            "refit_rounds": self.stats.refit_rounds,
+            "refit_reasons": dict(self.refit_reasons),
+            "refits_skipped_fit_budget": self.stats.refits_skipped_fit_budget,
+            "slab_growths": self.stats.slab_growths,
+            "growths_precompiled": self.stats.growths_precompiled,
+            "capacity": self._slab.capacity,
+            "fill": self._fill,
+            "labeled": self._labeled,
+            "latency_causes": dict(self.cause_counts),
+            "recompiles_after_warmup": self.recompiles_after_warmup(),
+        }
+
+
+class _BatchedRefit:
+    """One in-flight tenant-axis re-fit launch: the shared handle every
+    participating tenant's ``_inflight`` points at. Touchdown unstacks the
+    grid chunk's ``[K, T, ...]`` ys and ``[T, ...]`` carry back onto each
+    participant — non-candidate group members rode as masked no-ops and are
+    skipped (their carry passed through untouched; outputs are discards)."""
+
+    def __init__(
+        self,
+        manager: "TenantManager",
+        members: List[Tenant],
+        participants: Dict[str, Tuple[int, str]],  # tid -> (cell index, reason)
+        caps_at_dispatch: List[int],
+        out_grid,
+        extras,
+        ys,
+        t0: float,
+        tracker: _ProgramTracker,
+    ):
+        self.manager = manager
+        self.members = members
+        self.participants = participants
+        self.caps_at_dispatch = caps_at_dispatch
+        self.out_grid = out_grid
+        self.extras = extras
+        self.ys = ys
+        self.t0 = t0
+        self.tracker = tracker
+        self.polls = 0
+        self.done = False
+        self._poll_limit = min(t.serve.refit_poll_events for t in members)
+
+    def poll(self, force: bool = False) -> None:
+        if self.done:
+            return
+        self.polls += 1
+        ready = True
+        probe = getattr(self.extras.n_labeled_after, "is_ready", None)
+        if probe is not None and not force:
+            ready = bool(probe())
+        if force or ready or self.polls >= self._poll_limit:
+            self.touchdown()
+
+    def touchdown(self) -> None:
+        if self.done:
+            return
+        self.done = True
+        ys = self.ys
+        active_all = np.asarray(ys[4])          # [K, T] bool
+        dt = time.perf_counter() - self.t0
+        n_parts = len(self.participants)
+        # One fetch of the whole stacked metrics pytree, host-sliced per cell
+        # (the sweep-touchdown discipline — never one transfer per tenant).
+        dicts_by_cell = telemetry.stacked_sweep_metrics_to_dicts(ys[5], active_all)
+        rounds_all = np.asarray(ys[0])
+        labeled_all = np.asarray(ys[1])
+        acc_all = np.asarray(ys[2])
+        by_id = {t.tenant_id: (i, t) for i, t in enumerate(self.members)}
+        for tid, (cell, reason) in self.participants.items():
+            i, t = by_id[tid]
+            assert i == cell
+            t._inflight = None
+            active_np = active_all[:, i]
+            n_active = int(active_np.sum())
+            cap_i = self.caps_at_dispatch[i]
+            mask_out = self.out_grid.labeled_mask[i, :cap_i]
+            if t._slab.capacity > cap_i:  # the tenant grew mid-flight
+                mask_out = jnp.pad(mask_out, (0, t._slab.capacity - cap_i))
+            host_mask = np.asarray(mask_out)
+            t._slab = t._slab.replace(labeled_mask=jnp.asarray(host_mask))
+            t._key = self.out_grid.key[i]
+            t._round = self.out_grid.round[i]
+            t._labeled = int(host_mask[:cap_i].sum())
+            t._round_host += n_active
+            t.stats.refit_rounds += n_active
+            telemetry.flight_record(
+                "touchdown", tenant=tid, program=self.tracker.program,
+                reason=reason, n_active=n_active,
+                n_labeled_after=t._labeled,
+                seconds=round(dt, 6), polls=self.polls, batched=True,
+            )
+            if n_active:
+                sel = np.flatnonzero(active_np)
+                t._absorb_rounds(
+                    rounds_all[sel, i], labeled_all[sel, i], acc_all[sel, i],
+                    dicts_by_cell[i], dt / n_active,
+                )
+        self.tracker.record(dt, tenants=n_parts)
+
+
+@dataclasses.dataclass
+class _PrecompileJob:
+    kind: str                       # "capacity" | "batched_chunk"
+    tenant: Optional[Tenant]
+    capacity: int
+    group_key: Optional[tuple] = None
+    done: threading.Event = dataclasses.field(default_factory=threading.Event)
+    ok: bool = False
+
+
+class TenantManager:
+    """N resident tenants, the cross-tenant fused paths, and the AOT
+    capacity-precompile worker. See the module docstring for the design;
+    the short form:
+
+    - ``add_tenant`` makes a dataset x model resident (restoring from the
+      tenant-axis serve checkpoint when one exists);
+    - ``score_many`` fuses concurrent score requests into one vmapped launch
+      (per-tenant fallback with a named reason when forests can't stack);
+    - drift-triggered re-fits from same-configuration tenants coalesce into
+      one tenant-axis grid-chunk launch;
+    - slab growth swaps in background-AOT-compiled executables instead of
+      paying XLA compile on the triggering request.
+    """
+
+    def __init__(self, metrics=None, checkpoint_dir: Optional[str] = None):
+        self.metrics = metrics
+        self.checkpoint_dir = checkpoint_dir
+        self._tenants: Dict[str, Tenant] = {}
+        self._lock = threading.RLock()
+        # batched scoring
+        self._batched_score_fn = make_batched_score_fn()
+        self._batched_score_tracker = _ProgramTracker(
+            metrics, "serve_batched_score", self._batched_score_fn
+        )
+        self._stacked_forest = None
+        self._stacked_dirty = True
+        self._batched_reason_cache: Optional[Tuple[Optional[str]]] = None
+        self.batched_score_launches = 0
+        self.score_fallback_reasons: Dict[str, int] = {}
+        # tenant-axis batched re-fit
+        self._grid_fits: Dict[tuple, object] = {}
+        self._batched_chunks: Dict[tuple, Tuple[object, _ProgramTracker]] = {}
+        self.batched_refit_launches = 0
+        # AOT precompile worker (lazily started)
+        self._queue: "queue_lib.Queue[Optional[_PrecompileJob]]" = queue_lib.Queue()
+        self._pending: Dict[tuple, _PrecompileJob] = {}
+        self._worker: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.precompiles = 0
+        self.precompile_errors = 0
+        _LIVE_MANAGERS.add(self)
+
+    # -- tenancy -------------------------------------------------------------
+
+    def add_tenant(
+        self,
+        tenant_id: str,
+        cfg: ExperimentConfig,
+        serve: ServeConfig,
+        train_x,
+        train_y,
+        test_x,
+        test_y,
+        ckpt_name: str = "__tenant_id__",
+    ) -> Tenant:
+        """Make a tenant resident (cold start, or resumed from its tenant-axis
+        serve checkpoint when ``checkpoint_dir`` holds one). ``ckpt_name``
+        defaults to the tenant id; ``None`` keeps the PR-7 single-tenant file
+        names (the :class:`~serving.service.ALService` compatibility route).
+        """
+        if not _TENANT_ID_RE.fullmatch(tenant_id):
+            raise ValueError(
+                f"tenant id {tenant_id!r} must match {_TENANT_ID_RE.pattern} "
+                "(it names checkpoint files and telemetry streams)"
+            )
+        with self._lock:
+            if tenant_id in self._tenants:
+                raise ValueError(f"tenant {tenant_id!r} is already resident")
+            tenant = Tenant(
+                tenant_id, cfg, serve, train_x, train_y, test_x, test_y,
+                metrics=self.metrics,
+                checkpoint_dir=self.checkpoint_dir,
+                ckpt_name=tenant_id if ckpt_name == "__tenant_id__" else ckpt_name,
+                manager=self,
+            )
+            self._tenants[tenant_id] = tenant
+            self._stacked_dirty = True
+            self._batched_reason_cache = None
+        if self.metrics is not None:
+            self.metrics.event(
+                "tenant_added", tenant=tenant_id,
+                capacity=tenant._slab.capacity, fill=tenant._fill,
+                n_classes=tenant.n_classes,
+                strategy=cfg.strategy.name,
+            )
+        tenant._schedule_precompile()
+        return tenant
+
+    def tenant(self, tenant_id: str) -> Tenant:
+        return self._tenants[tenant_id]
+
+    @property
+    def tenant_ids(self) -> List[str]:
+        return list(self._tenants)
+
+    def __len__(self) -> int:
+        return len(self._tenants)
+
+    # -- scoring -------------------------------------------------------------
+
+    def score(self, tenant_id: str, queries) -> np.ndarray:
+        """Single-tenant scoring path (the PR-7 endpoint, byte-compatible)."""
+        return self._tenants[tenant_id].score(queries)
+
+    def submit(self, tenant_id: str, x, y) -> None:
+        self._tenants[tenant_id].submit(x, y)
+
+    def _batched_score_reason(self) -> Optional[str]:
+        """None when the cross-tenant fused path may serve; a named fallback
+        reason otherwise (recorded in the summary, never silent)."""
+        if self._batched_reason_cache is not None:
+            return self._batched_reason_cache[0]
+        reason = None
+        tenants = list(self._tenants.values())
+        if len(tenants) < 2:
+            reason = "single_tenant"
+        elif len({t._forest_sig for t in tenants}) > 1:
+            reason = "forest_structure"
+        elif any(t.cfg.forest.kernel not in _BATCHABLE_KERNELS for t in tenants):
+            reason = "kernel"
+        elif len({t.serve.score_width for t in tenants}) > 1:
+            reason = "score_width"
+        elif len({int(t._slab.x.shape[1]) for t in tenants}) > 1:
+            reason = "feature_width"
+        self._batched_reason_cache = (reason,)
+        return reason
+
+    def _mark_forest_dirty(self) -> None:
+        self._stacked_dirty = True
+
+    def _stacked(self):
+        if self._stacked_dirty or self._stacked_forest is None:
+            forests = [t._forest for t in self._tenants.values()]
+            self._stacked_forest = jax.tree_util.tree_map(
+                lambda *ls: jnp.stack(ls), *forests
+            )
+            self._stacked_dirty = False
+        return self._stacked_forest
+
+    def score_many(self, requests: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        """Serve concurrent score requests from several tenants as fused
+        cross-tenant launches (ONE program execution per width-round).
+
+        The tenant axis spans EVERY resident tenant (absent ones ride as
+        zero-valid padding — the aval-stability discipline), so the program
+        compiles once per resident-set size. Requests wider than
+        ``score_width`` are served in width-rounds: each round launches one
+        batch holding every tenant's next sub-block. Falls back to the
+        per-tenant endpoint (same results, N launches) with a named reason
+        when forests cannot stack.
+        """
+        order = [tid for tid in self._tenants if tid in requests]
+        unknown = set(requests) - set(order)
+        if unknown:
+            raise KeyError(f"unknown tenants in score_many: {sorted(unknown)}")
+        if not order:
+            return {}
+        reason = self._batched_score_reason()
+        if reason is not None:
+            self.score_fallback_reasons[reason] = (
+                self.score_fallback_reasons.get(reason, 0) + 1
+            )
+            return {tid: self._tenants[tid].score(requests[tid]) for tid in order}
+        tenants_all = list(self._tenants.values())
+        width = tenants_all[0].serve.score_width
+        d = int(tenants_all[0]._slab.x.shape[1])
+        arrays: Dict[str, np.ndarray] = {}
+        for tid in order:
+            q = np.asarray(requests[tid], np.float32)
+            if q.ndim == 1:
+                q = q[None, :]
+            arrays[tid] = q
+        outs: Dict[str, list] = {tid: [] for tid in order}
+        pos = {tid: 0 for tid in order}
+        while any(pos[tid] < arrays[tid].shape[0] for tid in order):
+            self.poll()  # once per distinct in-flight launch per width-round
+            qpad = np.zeros((len(tenants_all), width, d), np.float32)
+            n_valid = [0] * len(tenants_all)
+            for i, t in enumerate(tenants_all):
+                tid = t.tenant_id
+                if tid not in arrays or pos[tid] >= arrays[tid].shape[0]:
+                    continue
+                block = arrays[tid][pos[tid] : pos[tid] + width]
+                pos[tid] += block.shape[0]
+                qpad[i, : block.shape[0]] = block
+                n_valid[i] = block.shape[0]
+            t0 = time.perf_counter()
+            scores, ents = self._batched_score_fn(self._stacked(), jnp.asarray(qpad))
+            scores_np = np.asarray(scores)  # the one blocking fetch = latency
+            dt = time.perf_counter() - t0
+            ents_np = np.asarray(ents)
+            self._batched_score_tracker.record(
+                dt, tenants=sum(1 for n in n_valid if n)
+            )
+            self.batched_score_launches += 1
+            for i, t in enumerate(tenants_all):
+                n = n_valid[i]
+                if not n:
+                    continue
+                outs[t.tenant_id].append(scores_np[i, :n])
+                t._finish_query(
+                    dt, n, float(np.mean(ents_np[i, :n])), batched=True
+                )
+            self._maybe_refit_group()
+        return {
+            tid: (
+                np.concatenate(outs[tid]) if len(outs[tid]) > 1
+                else outs[tid][0] if outs[tid]
+                else np.zeros((0,), np.float32)  # empty request: empty result
+            )
+            for tid in order
+        }
+
+    # -- re-fit grouping -------------------------------------------------------
+
+    def _maybe_refit_group(self) -> None:
+        """Collect drift-triggered re-fit candidates across tenants; dispatch
+        same-signature groups of >= 2 as ONE tenant-axis chunk launch, the
+        rest through the single-tenant path."""
+        candidates: List[Tuple[Tenant, str]] = []
+        for t in self._tenants.values():
+            reason = t._refit_candidate()
+            if reason is not None:
+                candidates.append((t, reason))
+        if not candidates:
+            return
+        self._dispatch_refits(candidates)
+
+    def refit_now(self, reason: str = "manual") -> int:
+        """Dispatch re-fits for every eligible tenant immediately (warmup,
+        operator request) — batched per signature group; returns how many
+        tenants actually launched."""
+        candidates = []
+        for t in self._tenants.values():
+            if t._inflight is not None or t._fit_budget_exhausted:
+                continue
+            if t._check_refit_guards(reason) is None:
+                continue
+            candidates.append((t, reason))
+        self._dispatch_refits(candidates)
+        return len(candidates)
+
+    def _dispatch_refits(self, candidates: List[Tuple[Tenant, str]]) -> None:
+        groups: Dict[tuple, List[Tuple[Tenant, str]]] = {}
+        singles: List[Tuple[Tenant, str]] = []
+        for t, reason in candidates:
+            if t._batchable_refit_reason() is None:
+                groups.setdefault(t._chunk_signature(), []).append((t, reason))
+            else:
+                singles.append((t, reason))
+        for sig, members in groups.items():
+            if len(members) >= 2:
+                self._dispatch_batched_refit(sig, members)
+            else:
+                singles.extend(members)
+        for t, reason in singles:
+            t._dispatch_refit(reason)
+
+    def _group_members(self, sig: tuple) -> List[Tenant]:
+        """Every resident tenant sharing a chunk signature, in registration
+        order — the STABLE tenant axis a batched re-fit launches over
+        (non-candidates ride as masked no-ops, so varying candidate subsets
+        never change the program's avals)."""
+        return [
+            t for t in self._tenants.values()
+            if t._batchable_refit_reason() is None and t._chunk_signature() == sig
+        ]
+
+    def _batched_chunk_for(
+        self, sig: tuple, members: List[Tenant], cap_max: int, aot: bool = False
+    ):
+        """The tenant-axis chunk program for one signature group at one padded
+        capacity: the PR-9 grid chunk with tenants as the dataset axis
+        (G=1, D=T, E=1), per-tenant edges/fills/test sets riding the per-cell
+        inputs. Cached per (signature, T, cap_max, test shape)."""
+        from distributed_active_learning_tpu.runtime.loop import make_grid_device_fit
+        from distributed_active_learning_tpu.runtime.sweep import (
+            SweepState,
+            make_grid_chunk_fn,
+        )
+
+        rep = members[0]
+        t_max = max(int(t._test_x.shape[0]) for t in members)
+        use_test_fill = len({int(t._test_x.shape[0]) for t in members}) > 1
+        key = (sig, len(members), cap_max, t_max, use_test_fill)
+        with self._lock:
+            cached = self._batched_chunks.get(key)
+        if cached is not None:
+            return cached
+        grid_fit = self._grid_fits.get(sig)
+        if grid_fit is None:
+            grid_fit = make_grid_device_fit(rep.cfg, rep._fit_budget, rep.n_classes)
+            self._grid_fits[sig] = grid_fit
+        chunk = make_grid_chunk_fn(
+            [rep._strategy],
+            rep.cfg.strategy.window_size,
+            rep.serve.refit_rounds,
+            grid_fit,
+            n_datasets=len(members),
+            n_seeds=1,
+            use_fill=True,
+            use_test_fill=use_test_fill,
+            with_metrics=True,
+            n_classes=rep.n_classes,
+        )
+        if aot:
+            T = len(members)
+            d = int(rep._slab.x.shape[1])
+            bins = int(rep._edges.shape[1])
+            keys_aval = _aval(
+                jax.eval_shape(lambda: jax.random.split(jax.random.key(0), T))
+            )
+            grid_aval = SweepState(
+                labeled_mask=_sds((T, cap_max), jnp.bool_),
+                key=keys_aval,
+                round=_sds((T,), jnp.int32),
+            )
+            chunk = chunk.lower(
+                _sds((T, cap_max, d), jnp.int32),    # codes
+                _sds((T, cap_max, d), jnp.float32),  # x
+                _sds((T, cap_max), jnp.int32),       # oracle_y
+                grid_aval,                           # donated carry
+                _sds((T, cap_max), jnp.bool_),       # seed_masks
+                (None,),                             # lal_forests (refused above)
+                keys_aval,                           # fit_keys
+                _sds((T,), jnp.int32),               # windows
+                _sds((T, t_max, d), jnp.float32),    # test_x
+                _sds((T, t_max), jnp.int32),         # test_y
+                _sds((T,), jnp.int32),               # end_rounds
+                _sds((T,), jnp.int32),               # label_caps
+                _sds((T, d, bins), jnp.float32),     # edges
+                _sds((T,), jnp.int32),               # n_valids
+                _sds((T,), jnp.int32),               # test_ns
+            ).compile()
+        tracker = _ProgramTracker(
+            self.metrics, f"serve_chunk_multi@{len(members)}x{cap_max}", chunk
+        )
+        with self._lock:
+            return self._batched_chunks.setdefault(key, (chunk, tracker))
+
+    def _dispatch_batched_refit(
+        self, sig: tuple, candidates: List[Tuple[Tenant, str]]
+    ) -> None:
+        members = self._group_members(sig)
+        # Members already mid-refit may ride as no-ops (their inputs are
+        # device futures that simply queue behind their own chunk); their
+        # outputs are discarded. Candidates are never inflight (guarded).
+        want = {t.tenant_id: reason for t, reason in candidates}
+        cap_max = max(t._slab.capacity for t in members)
+        chunk, tracker = self._batched_chunk_for(sig, members, cap_max)
+        T = len(members)
+        t_max = max(int(t._test_x.shape[0]) for t in members)
+        K = members[0].serve.refit_rounds
+
+        def pad_rows(arr, rows):
+            pad = rows - arr.shape[0]
+            if pad == 0:
+                return arr
+            widths = ((0, pad),) + ((0, 0),) * (arr.ndim - 1)
+            return jnp.pad(arr, widths)
+
+        caps = [t._slab.capacity for t in members]
+        codes = jnp.stack([pad_rows(t._slab.codes, cap_max) for t in members])
+        x = jnp.stack([pad_rows(t._slab.x, cap_max) for t in members])
+        oy = jnp.stack([pad_rows(t._slab.oracle_y, cap_max) for t in members])
+        # Padding rows beyond a tenant's own capacity are labeled=True
+        # sentinels (the grid convention: never selectable, excluded from
+        # real-row counts by the per-cell n_valids watermark below).
+        masks = jnp.stack([
+            jnp.pad(t._slab.labeled_mask, (0, cap_max - c), constant_values=True)
+            for t, c in zip(members, caps)
+        ])
+        seed_masks = jnp.stack([
+            pad_rows(
+                t._aux.seed_mask
+                if t._aux.seed_mask is not None
+                else jnp.zeros((c,), bool),
+                cap_max,
+            )
+            for t, c in zip(members, caps)
+        ])
+        from distributed_active_learning_tpu.runtime.sweep import SweepState
+
+        grid = SweepState(
+            labeled_mask=masks,
+            key=jnp.stack([t._key for t in members]),
+            round=jnp.stack([jnp.asarray(t._round, jnp.int32) for t in members]),
+        )
+        fit_keys = jnp.stack([t._fit_key for t in members])
+        windows = jnp.asarray(
+            [t.cfg.strategy.window_size for t in members], jnp.int32
+        )
+        test_x = jnp.stack([pad_rows(t._test_x, t_max) for t in members])
+        test_y = jnp.stack(
+            [pad_rows(jnp.asarray(t._test_y, jnp.int32), t_max) for t in members]
+        )
+        # Non-candidates no-op from step one: end_round == their current
+        # round, so active is False and select_state passes their carry
+        # through untouched — the aval-stable tenant axis.
+        end_rounds = jnp.asarray(
+            [
+                t._round_host + (K if t.tenant_id in want else 0)
+                for t in members
+            ],
+            jnp.int32,
+        )
+        label_caps = jnp.asarray(caps, jnp.int32)
+        edges = jnp.stack([t._edges for t in members])
+        n_valids = jnp.stack(
+            [jnp.asarray(t._slab.n_filled, jnp.int32) for t in members]
+        )
+        test_ns = jnp.asarray(
+            [int(t._test_x.shape[0]) for t in members], jnp.int32
+        )
+        t0 = time.perf_counter()
+        out_grid, extras, ys = chunk(
+            codes, x, oy, grid, seed_masks, (None,), fit_keys, windows,
+            test_x, test_y, end_rounds, label_caps, edges, n_valids, test_ns,
+        )
+        participants = {
+            t.tenant_id: (i, want[t.tenant_id])
+            for i, t in enumerate(members)
+            if t.tenant_id in want
+        }
+        br = _BatchedRefit(
+            self, members, participants, caps, out_grid, extras, ys, t0, tracker
+        )
+        self.batched_refit_launches += 1
+        for t, reason in candidates:
+            t._inflight = br
+            t._record_refit_dispatch(reason)
+
+    # -- lifecycle / shared ops ----------------------------------------------
+
+    def poll(self, force: bool = False) -> None:
+        """Non-blocking touchdown check for every tenant's in-flight re-fit
+        (``force=True`` blocks — the flush/quiesce path). One poll per
+        distinct launch per call: a tenant-axis batched re-fit is shared by
+        its participants, and counting it once per TENANT would hit the
+        forced-touchdown limit (``ServeConfig.refit_poll_events`` — pending
+        score EVENTS tolerated) P times too early."""
+        seen: set = set()
+        for t in self._tenants.values():
+            inflight = t._inflight
+            if isinstance(inflight, _BatchedRefit):
+                if id(inflight) in seen:
+                    continue
+                seen.add(id(inflight))
+            t._poll_refit(force=force)
+
+    def flush(self) -> None:
+        for t in self._tenants.values():
+            t.flush()
+
+    def save_checkpoints(self) -> Dict[str, Optional[str]]:
+        """Persist every tenant's serve checkpoint (tenant-axis file names);
+        a restarted manager re-adding the same tenants resumes all of them
+        bit-identically (round-trip pinned in tests/test_serving_multi.py)."""
+        return {tid: t.save_checkpoint() for tid, t in self._tenants.items()}
+
+    def mark_warmup_complete(self) -> None:
+        """Zero the per-tenant latency-cause tables: every cause counted
+        after this call is a POST-warmup event — the serve-multi bench's
+        ``slab_growth_compile`` acceptance gate reads exactly this."""
+        for t in self._tenants.values():
+            t.cause_counts.clear()
+
+    def recompiles_after_warmup(self) -> int:
+        total = self._batched_score_tracker.recompiles
+        for _, tracker in self._batched_chunks.values():
+            total += tracker.recompiles
+        for t in self._tenants.values():
+            total += t.recompiles_after_warmup()
+        return total
+
+    def post_warmup_growth_compile_events(self) -> int:
+        """serve_latency events tagged ``slab_growth_compile`` since
+        :meth:`mark_warmup_complete` — the p99 spike the AOT precompile
+        exists to kill; the serve-multi bench asserts 0."""
+        return sum(
+            t.cause_counts.get("slab_growth_compile", 0)
+            for t in self._tenants.values()
+        )
+
+    def summary(self) -> Dict:
+        per_tenant = {tid: t.summary() for tid, t in self._tenants.items()}
+        agg = {
+            k: sum(s[k] for s in per_tenant.values())
+            for k in (
+                "queries", "scored_points", "ingest_blocks", "ingested_points",
+                "refits", "refit_rounds", "slab_growths", "growths_precompiled",
+            )
+        }
+        return {
+            "tenants": len(self._tenants),
+            **agg,
+            "batched_score_launches": self.batched_score_launches,
+            "batched_refit_launches": self.batched_refit_launches,
+            "score_fallback_reasons": dict(self.score_fallback_reasons),
+            "precompiles": self.precompiles,
+            "precompile_errors": self.precompile_errors,
+            "post_warmup_growth_compile_events":
+                self.post_warmup_growth_compile_events(),
+            "recompiles_after_warmup": self.recompiles_after_warmup(),
+            "per_tenant": per_tenant,
+        }
+
+    # -- AOT precompile worker -------------------------------------------------
+
+    def _ensure_worker(self) -> None:
+        with self._lock:
+            if self._worker is None or not self._worker.is_alive():
+                self._stop.clear()
+                self._worker = threading.Thread(
+                    target=self._worker_loop,
+                    name="serve-precompile",
+                    daemon=True,
+                )
+                self._worker.start()
+
+    def schedule_precompile(self, tenant: Tenant, capacity: int) -> bool:
+        """Queue AOT builds of ``tenant``'s next-capacity programs (and the
+        tenant-axis chunk at the group's resulting max capacity). Dedups
+        against pending jobs and already-resident programs; returns whether
+        anything new was queued."""
+        queued = False
+        with tenant._programs_lock:
+            have = capacity in tenant._programs
+        key = ("capacity", tenant.tenant_id, capacity)
+        with self._lock:
+            if not have and key not in self._pending:
+                job = _PrecompileJob("capacity", tenant, capacity)
+                self._pending[key] = job
+                self._queue.put(job)
+                queued = True
+        if tenant._batchable_refit_reason() is None:
+            sig = tenant._chunk_signature()
+            members = self._group_members(sig)
+            if len(members) >= 2:
+                cap_max = max(
+                    [capacity] + [t._slab.capacity for t in members]
+                )
+                t_max = max(int(t._test_x.shape[0]) for t in members)
+                use_tf = len({int(t._test_x.shape[0]) for t in members}) > 1
+                ck = (sig, len(members), cap_max, t_max, use_tf)
+                with self._lock:
+                    if (
+                        ck not in self._batched_chunks
+                        and ("batched", ck) not in self._pending
+                    ):
+                        job = _PrecompileJob(
+                            "batched_chunk", tenant, cap_max, group_key=ck
+                        )
+                        self._pending[("batched", ck)] = job
+                        self._queue.put(job)
+                        queued = True
+        if queued:
+            self._ensure_worker()
+        return queued
+
+    def wait_precompile(
+        self, tenant: Tenant, capacity: int, timeout: Optional[float] = None
+    ) -> bool:
+        """Block until a pending precompile of ``tenant``'s ``capacity``
+        lands (True) — the growth path uses this instead of racing a second
+        compile of the same programs; False when no such job is pending."""
+        with self._lock:
+            job = self._pending.get(("capacity", tenant.tenant_id, capacity))
+        if job is None:
+            return False
+        job.done.wait(timeout)
+        return job.ok
+
+    def wait_precompiles(self, timeout: Optional[float] = None) -> bool:
+        """Test/bench barrier: wait for every queued precompile to land."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            with self._lock:
+                jobs = list(self._pending.values())
+            if not jobs:
+                return True
+            for job in jobs:
+                remaining = (
+                    None if deadline is None else max(deadline - time.monotonic(), 0)
+                )
+                if not job.done.wait(remaining):
+                    return False
+
+    def _worker_loop(self) -> None:
+        while True:
+            job = self._queue.get()
+            if job is None or self._stop.is_set():
+                # release anything still waiting on abandoned jobs
+                with self._lock:
+                    pending = list(self._pending.values())
+                    self._pending.clear()
+                for p in pending:
+                    p.done.set()
+                if job is not None:
+                    job.done.set()
+                return
+            t0 = time.perf_counter()
+            try:
+                if job.kind == "capacity":
+                    progs = job.tenant._build_programs(job.capacity, aot=True)
+                    job.ok = job.tenant._install_programs(job.capacity, progs)
+                else:
+                    sig, T, cap_max, _t_max, _use_tf = job.group_key
+                    members = self._group_members(sig)
+                    if len(members) == T:
+                        self._batched_chunk_for(sig, members, cap_max, aot=True)
+                        job.ok = True
+                self.precompiles += 1
+                seconds = round(time.perf_counter() - t0, 3)
+                telemetry.flight_record(
+                    "precompile", target=job.kind,
+                    tenant=job.tenant.tenant_id, capacity=job.capacity,
+                    seconds=seconds, installed=job.ok,
+                )
+                if self.metrics is not None:
+                    self.metrics.event(
+                        "precompile", target=job.kind,
+                        tenant=job.tenant.tenant_id, capacity=job.capacity,
+                        seconds=seconds, installed=job.ok,
+                    )
+            except Exception as e:  # noqa: BLE001 — a failed AOT build must
+                # never kill the worker: the lazy request path still compiles,
+                # the failure is just a (named) lost optimization.
+                self.precompile_errors += 1
+                telemetry.flight_record(
+                    "precompile_error", target=job.kind,
+                    tenant=job.tenant.tenant_id, capacity=job.capacity,
+                    error=repr(e)[:200],
+                )
+                if self.metrics is not None:
+                    self.metrics.event(
+                        "precompile_error", target=job.kind,
+                        tenant=job.tenant.tenant_id, capacity=job.capacity,
+                        error=repr(e)[:200],
+                    )
+            finally:
+                with self._lock:
+                    for k, v in list(self._pending.items()):
+                        if v is job:
+                            del self._pending[k]
+                job.done.set()
+                self._queue.task_done()
+
+    def close(self) -> None:
+        """Stop the precompile worker (idempotent). Called by atexit for
+        every live manager — a worker aborted MID-compile at interpreter
+        teardown takes the whole process down, so shutdown waits out the
+        in-flight build (bounded) instead."""
+        with self._lock:
+            worker = self._worker
+            self._worker = None
+        self._stop.set()
+        if worker is not None and worker.is_alive():
+            self._queue.put(None)
+            worker.join(timeout=30)

@@ -419,10 +419,10 @@ def test_mesh_chunk_audits_clean(devices):
     assert report.findings == [], [str(f) for f in report.findings]
 
 
-@pytest.mark.slow  # the full matrix (~51 traced programs, ~50s) runs in CI
+@pytest.mark.slow  # the full matrix (~73 traced programs, ~60s) runs in CI
 def test_full_registry_audits_clean():
     report = run_audit(build_registry())
-    assert len(report.programs) >= 45
+    assert len(report.programs) >= 49
     assert report.findings == [], [str(f) for f in report.findings]
 
 
@@ -443,6 +443,12 @@ def test_registry_covers_every_strategy_and_kind():
     # the PR-9 grid launcher: one heterogeneous-group program per placement
     for placement in ("cpu", "mesh4x2"):
         assert f"grid/uncertainty+margin+density/{placement}" in names
+    # the PR-12 multi-tenant serving surface: the fused endpoint + per-tenant
+    # ingest (cpu) and the tenant-axis chunk in both placements
+    assert "serve_multi/batched_score/cpu" in names
+    assert "serve_multi/ingest/cpu" in names
+    for placement in ("cpu", "mesh4x2"):
+        assert f"serve_multi/chunk/{placement}" in names
 
 
 @pytest.mark.slow  # one heavy trace; the CI analysis job audits it per-PR

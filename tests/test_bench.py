@@ -119,7 +119,8 @@ def test_mode_all_deadline_skips_are_structured(bench):
     assert out["metric"] == "none_completed_before_deadline"
     skips = out["modes_skipped"]
     assert [s["mode"] for s in skips] == [
-        "score", "density", "round", "sweep", "grid", "serve", "lal", "neural",
+        "score", "density", "round", "sweep", "grid", "serve", "serve-multi",
+        "lal", "neural",
     ]
     for s in skips:
         assert s["reason"] == "deadline_exceeded"

@@ -1,0 +1,486 @@
+"""Multi-tenant serving: fused cross-tenant paths, AOT precompile, frontend.
+
+The load-bearing guarantees pinned here:
+
+- **Batched serving is bit-identical to independent services** — N tenants
+  driven through the TenantManager's fused score path and tenant-axis
+  batched re-fits produce EXACTLY the scores, selections (labeled masks),
+  and PRNG key states of N independent single-tenant ALService instances fed
+  the same traffic (the acceptance criterion; the mesh twin of the
+  tenant-axis chunk is slow-marked below).
+- **Slab growth is an executable swap** — the background AOT precompile
+  (``lower().compile()``) lands the next capacity's programs before the
+  watermark reaches it, so growth finds them resident: no
+  ``slab_growth_compile``-caused latency event, zero recompiles, and the
+  installed programs are genuinely AOT (the ``aot`` flag, pinned).
+- **The frontend actually contends** — concurrent client threads coalesce
+  into fused launches with per-tenant FIFO order kept, admission refuses
+  past ``max_pending``, and a tenant's held ingests (re-fit in flight) are
+  overtaken by its scores, never the other way.
+- **The tenant-axis checkpoint format round-trips** — a restarted manager
+  re-adding the same tenants resumes every one bit-identically, and a
+  renamed tenant file is refused instead of cross-wiring pools.
+"""
+
+import os
+import sys
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_active_learning_tpu.config import (
+    ExperimentConfig,
+    ForestConfig,
+    ServeConfig,
+    StrategyConfig,
+)
+from distributed_active_learning_tpu.serving.frontend import (
+    AdmissionError,
+    ServiceFrontend,
+)
+from distributed_active_learning_tpu.serving.service import ALService
+from distributed_active_learning_tpu.serving.tenants import TenantManager
+
+T = 3
+
+
+def _points(n, d=4, seed=0, shift=0.0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d)).astype(np.float32) + shift
+    y = (x[:, 0] + 0.3 * x[:, 1] > shift).astype(np.int32)
+    return x, y
+
+
+def _tenant_cfg(i):
+    cfg = ExperimentConfig(
+        forest=ForestConfig(
+            n_trees=6, max_depth=3, max_bins=8, fit="device", fit_budget=64
+        ),
+        strategy=StrategyConfig(name="uncertainty", window_size=4),
+        n_start=6,
+        log_every=0,
+        seed=i,
+    )
+    serve = ServeConfig(
+        slab_rows=64,
+        ingest_block=16,
+        score_width=16,
+        refit_rounds=2,
+        max_staleness=0,            # drift refits only where a test forces them
+        drift_entropy_shift=99.0,
+        precompile_ahead=True,
+        precompile_headroom_slabs=1.0,
+    )
+    return cfg, serve
+
+
+def _tenant_data(i):
+    x0, y0 = _points(40, seed=10 + i, shift=0.3 * i)
+    tx, ty = _points(24, seed=50 + i, shift=0.3 * i)
+    return x0, y0, tx, ty
+
+
+@pytest.fixture(scope="module")
+def driven_multi(tmp_path_factory):
+    """One T=3 manager and 3 independent ALServices driven through IDENTICAL
+    traffic — fused scoring, a batched re-fit, a post-growth leg, and a
+    checkpoint — shared by the assertions below (chunk compiles dominate;
+    one drive serves them all)."""
+    ckpt_dir = str(tmp_path_factory.mktemp("serve_multi_ckpt"))
+    mgr = TenantManager(checkpoint_dir=ckpt_dir)
+    svcs = []
+    for i in range(T):
+        cfg, serve = _tenant_cfg(i)
+        x0, y0, tx, ty = _tenant_data(i)
+        mgr.add_tenant(f"t{i}", cfg, serve, x0, y0, tx, ty)
+        svcs.append(ALService(cfg, serve, x0, y0, tx, ty))
+
+    cap = {}
+    # fused scoring vs the per-tenant endpoint
+    q1 = {f"t{i}": _points(10, seed=90 + i)[0] for i in range(T)}
+    cap["batched_scores"] = mgr.score_many(q1)
+    cap["single_scores"] = {
+        f"t{i}": svcs[i].score(q1[f"t{i}"]) for i in range(T)
+    }
+    # identical ingest, then a tenant-axis batched re-fit vs 3 single ones
+    for i in range(T):
+        sx, sy = _points(16, seed=70 + i, shift=0.3 * i)
+        mgr.submit(f"t{i}", sx, sy)
+        svcs[i].submit(sx, sy)
+    cap["refit_launched"] = mgr.refit_now("test")
+    cap["batched_refit_launches"] = mgr.batched_refit_launches
+    for i in range(T):
+        assert svcs[i].refit_now("test")
+    mgr.flush()
+    for s in svcs:
+        s.flush()
+    cap["masks"] = {
+        f"t{i}": np.asarray(mgr.tenant(f"t{i}")._slab.labeled_mask)
+        for i in range(T)
+    }
+    cap["svc_masks"] = {
+        f"t{i}": np.asarray(svcs[i]._slab.labeled_mask) for i in range(T)
+    }
+    cap["keys"] = {
+        f"t{i}": np.asarray(jax.random.key_data(mgr.tenant(f"t{i}")._key))
+        for i in range(T)
+    }
+    cap["svc_keys"] = {
+        f"t{i}": np.asarray(jax.random.key_data(svcs[i]._tenant._key))
+        for i in range(T)
+    }
+    cap["labeled"] = {f"t{i}": mgr.tenant(f"t{i}")._labeled for i in range(T)}
+    cap["svc_labeled"] = {f"t{i}": svcs[i]._labeled for i in range(T)}
+    # post-refit scores serve from the refreshed resident forests
+    q2 = {f"t{i}": _points(8, seed=120 + i)[0] for i in range(T)}
+    cap["post_batched"] = mgr.score_many(q2)
+    cap["post_single"] = {
+        f"t{i}": svcs[i].score(q2[f"t{i}"]) for i in range(T)
+    }
+    cap["fallbacks"] = dict(mgr.score_fallback_reasons)
+    cap["batched_score_launches"] = mgr.batched_score_launches
+
+    # growth leg (manager only — the services arm is already captured): the
+    # AOT precompile must have landed, so crossing the slab boundary swaps
+    # executables instead of compiling on the request path
+    mgr.wait_precompiles(timeout=300)
+    t0 = mgr.tenant("t0")
+    mgr.mark_warmup_complete()
+    gx, gy = _points(64, seed=200)
+    mgr.submit("t0", gx, gy)
+    mgr.score_many({"t0": _points(6, seed=201)[0]})  # latency event post-growth
+    cap["t0_growths"] = t0.stats.slab_growths
+    cap["t0_growths_precompiled"] = t0.stats.growths_precompiled
+    cap["t0_causes"] = dict(t0.cause_counts)
+    cap["t0_aot_capacities"] = sorted(
+        c for c, p in t0._programs.items() if p.aot
+    )
+    cap["growth_compile_events"] = mgr.post_warmup_growth_compile_events()
+    cap["recompiles"] = mgr.recompiles_after_warmup()
+
+    # checkpoint every tenant, then capture the reference scores a restored
+    # manager must reproduce bit-for-bit
+    mgr.flush()
+    cap["ckpt_paths"] = mgr.save_checkpoints()
+    qr = {f"t{i}": _points(8, seed=140 + i)[0] for i in range(T)}
+    cap["ckpt_queries"] = qr
+    cap["ckpt_scores"] = mgr.score_many(qr)
+    cap["ckpt_fill"] = {f"t{i}": mgr.tenant(f"t{i}")._fill for i in range(T)}
+    cap["ckpt_labeled"] = {
+        f"t{i}": mgr.tenant(f"t{i}")._labeled for i in range(T)
+    }
+    return mgr, svcs, ckpt_dir, cap
+
+
+def test_batched_score_bit_identical_to_singles(driven_multi):
+    _, _, _, cap = driven_multi
+    for tid in cap["batched_scores"]:
+        np.testing.assert_array_equal(
+            cap["batched_scores"][tid], cap["single_scores"][tid]
+        )
+    assert cap["batched_score_launches"] >= 1
+    assert cap["fallbacks"] == {}  # the fused path served, never the fallback
+
+
+def test_batched_refit_bit_identical_selections(driven_multi):
+    """The tenant-axis chunk (ONE launch for all 3 tenants) must reveal
+    exactly the labels 3 independent single-tenant chunks reveal, and thread
+    the per-tenant PRNG keys identically."""
+    _, _, _, cap = driven_multi
+    assert cap["refit_launched"] == T
+    assert cap["batched_refit_launches"] == 1  # one launch, not T
+    for tid in cap["masks"]:
+        np.testing.assert_array_equal(cap["masks"][tid], cap["svc_masks"][tid])
+        np.testing.assert_array_equal(cap["keys"][tid], cap["svc_keys"][tid])
+    assert cap["labeled"] == cap["svc_labeled"]
+    assert all(v > 6 for v in cap["labeled"].values())  # labels were revealed
+
+
+def test_post_refit_scores_bit_identical(driven_multi):
+    _, _, _, cap = driven_multi
+    for tid in cap["post_batched"]:
+        np.testing.assert_array_equal(
+            cap["post_batched"][tid], cap["post_single"][tid]
+        )
+
+
+def test_growth_swaps_in_precompiled_programs(driven_multi):
+    """The AOT precompile acceptance: growth found the next capacity's
+    programs resident (genuinely AOT — the aot flag), no query was tagged
+    with the slab_growth_compile cause, and nothing silently recompiled."""
+    _, _, _, cap = driven_multi
+    assert cap["t0_growths"] >= 1
+    assert cap["t0_growths_precompiled"] == cap["t0_growths"]
+    assert "slab_growth_compile" not in cap["t0_causes"]
+    assert cap["growth_compile_events"] == 0
+    assert cap["recompiles"] == 0
+    assert cap["t0_aot_capacities"], "no AOT program set was installed"
+
+
+def test_multi_tenant_checkpoint_roundtrip(driven_multi):
+    """A restarted manager re-adding the same tenants resumes ALL of them
+    from the tenant-axis serve files: same fill/labeled, and the restored
+    resident forests score bit-identically."""
+    _, _, ckpt_dir, cap = driven_multi
+    assert all(p and os.path.exists(p) for p in cap["ckpt_paths"].values())
+    names = os.listdir(ckpt_dir)
+    for i in range(T):
+        assert any(n.startswith(f"servestate_t{i}_") for n in names), names
+    mgr2 = TenantManager(checkpoint_dir=ckpt_dir)
+    for i in range(T):
+        cfg, serve = _tenant_cfg(i)
+        mgr2.add_tenant(f"t{i}", cfg, serve, *_tenant_data(i))
+    for i in range(T):
+        tid = f"t{i}"
+        assert mgr2.tenant(tid)._fill == cap["ckpt_fill"][tid]
+        assert mgr2.tenant(tid)._labeled == cap["ckpt_labeled"][tid]
+    restored = mgr2.score_many(cap["ckpt_queries"])
+    for tid, ref in cap["ckpt_scores"].items():
+        np.testing.assert_array_equal(restored[tid], ref)
+    mgr2.close()
+
+
+def test_serve_checkpoint_refuses_cross_wired_tenant_file(driven_multi, tmp_path):
+    """Tenant-axis files store the id in the payload: a renamed file must be
+    refused, not silently resumed as another tenant's pool."""
+    import shutil
+
+    from distributed_active_learning_tpu.runtime import checkpoint as ckpt_lib
+
+    mgr, _, ckpt_dir, cap = driven_multi
+    src = cap["ckpt_paths"]["t0"]
+    step = os.path.basename(src).rsplit("_", 1)[1]
+    dst = os.path.join(tmp_path, f"servestate_t9_{step}")
+    shutil.copy(src, dst)
+    with pytest.raises(ValueError, match="cross-wire"):
+        ckpt_lib.restore_latest_serve(str(tmp_path), None, tenant="t9")
+    # and an invalid id is refused before touching the filesystem
+    with pytest.raises(ValueError, match="tenant id"):
+        ckpt_lib.latest_serve_step(ckpt_dir, tenant="no/slashes")
+
+
+def test_frontend_concurrent_clients_fused_and_fifo(driven_multi):
+    """Concurrent client threads coalesce into fused launches; per-tenant
+    results match the direct endpoint, in submission order."""
+    mgr, _, _, _ = driven_multi
+    before = mgr.batched_score_launches
+    queries = {
+        f"t{i}": [_points(6, seed=300 + 10 * i + j)[0] for j in range(3)]
+        for i in range(T)
+    }
+    results = {tid: [None] * 3 for tid in queries}
+    with ServiceFrontend(mgr) as fe:
+        def client(tid):
+            futs = [fe.submit_score(tid, q) for q in queries[tid]]
+            results[tid] = [f.result(timeout=60) for f in futs]
+
+        threads = [
+            threading.Thread(target=client, args=(tid,)) for tid in queries
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+    assert mgr.batched_score_launches > before  # requests actually fused
+    for tid in queries:
+        for j, q in enumerate(queries[tid]):
+            np.testing.assert_array_equal(
+                results[tid][j], mgr.tenant(tid).score(q)
+            )
+
+
+def test_frontend_admission_and_refit_backpressure(driven_multi, monkeypatch):
+    """While a tenant's re-fit is in flight its ingests are HELD (scores
+    overtake them) and a flooded queue is refused with AdmissionError."""
+    mgr, _, _, _ = driven_multi
+    t0 = mgr.tenant("t0")
+    monkeypatch.setattr(t0, "_poll_refit", lambda force=False: None)
+    t0._inflight = object()  # pin "re-fit in flight" deterministically
+    fe = ServiceFrontend(mgr, max_pending=3)
+    fe.start()
+    try:
+        bx, by = _points(4, seed=400)
+        held = [fe.submit_ingest("t0", bx, by) for _ in range(2)]
+        # a score submitted BEHIND the held ingests still completes: the
+        # resident forest stays hot through a re-fit
+        out = fe.score("t0", _points(5, seed=401)[0], timeout=60)
+        assert out.shape == (5,) and np.isfinite(out).all()
+        assert not any(f.done() for f in held)
+        # the held ingests pile up; the cap pushes back on the producer
+        held.append(fe.submit_ingest("t0", bx, by))
+        with pytest.raises(AdmissionError, match="backpressure"):
+            fe.submit_ingest("t0", bx, by)
+        assert fe.rejected.get("t0") == 1
+        assert not any(f.done() for f in held)
+    finally:
+        t0._inflight = None  # touchdown: held ingests may now drain
+        fe.stop(drain=True)
+    assert all(f.result(timeout=60)["points"] == 4 for f in held)
+
+
+def test_summarize_metrics_per_tenant_table():
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "benches"))
+    try:
+        import summarize_metrics as sm
+    finally:
+        sys.path.pop(0)
+    events = [
+        {"ts": 100.0 + 0.1 * i, "kind": "serve_latency", "tenant": "a",
+         "seconds": 0.010, "batch": 4}
+        for i in range(6)
+    ]
+    events += [
+        {"ts": 100.0 + 0.1 * i, "kind": "serve_latency", "tenant": "b",
+         "seconds": 0.200, "batch": 4}
+        for i in range(3)
+    ]
+    events += [
+        {"ts": 100.5, "kind": "ingest", "tenant": "b", "points": 32,
+         "seconds": 0.001, "fill": 64, "capacity": 128},
+        {"ts": 101.0, "kind": "refit", "tenant": "b", "reason": "staleness"},
+    ]
+    out = sm.summarize(events)
+    assert "== tenants ==" in out
+    tenants = out.split("== tenants ==")[1].splitlines()
+    row_a = next(ln for ln in tenants if ln.startswith("a"))
+    row_b = next(ln for ln in tenants if ln.startswith("b"))
+    # the noisy neighbor is nameable: b's latency, ingest, and refit load
+    assert "10.000" in row_a and row_a.split()[1] == "6"
+    assert "200.000" in row_b and "32" in row_b.split() and row_b.split()[-1] == "1"
+
+
+def test_batched_score_program_registered():
+    """The serve_multi registry kind covers the fused endpoint, the
+    per-tenant ingest, and the tenant-axis chunk in both placements
+    (string-only; the CI analysis job traces them all)."""
+    from distributed_active_learning_tpu.analysis import build_registry
+
+    names = {s.name for s in build_registry(kinds=["serve_multi"])}
+    assert "serve_multi/batched_score/cpu" in names
+    assert "serve_multi/ingest/cpu" in names
+    for placement in ("cpu", "mesh4x2"):
+        assert f"serve_multi/chunk/{placement}" in names
+
+
+@pytest.mark.slow  # ~20s mesh twin of the tenant-axis parity: the CPU
+# manager-level bit-identity stays tier-1 above; this pins the registered
+# serve_multi/chunk program shape on the real 4x2 mesh against per-tenant
+# single-device chunks (selection parity exact, accuracy allclose — the
+# grid mesh bar, test_grid.py::test_grid_on_sharded_mesh)
+def test_tenant_axis_chunk_parity_on_mesh(devices):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distributed_active_learning_tpu.ops import trees_train
+    from distributed_active_learning_tpu.parallel import make_mesh
+    from distributed_active_learning_tpu.parallel import mesh as mesh_lib
+    from distributed_active_learning_tpu.runtime import state as state_lib
+    from distributed_active_learning_tpu.runtime.loop import (
+        make_chunk_fn,
+        make_device_fit,
+        make_grid_device_fit,
+    )
+    from distributed_active_learning_tpu.runtime.sweep import (
+        SweepState,
+        make_grid_chunk_fn,
+    )
+    from distributed_active_learning_tpu.strategies import StrategyAux, get_strategy
+
+    mesh = make_mesh(data=4, model=2)
+    Tm, cap, d, K, window = 2, 64, 4, 2, 4
+    cfg, _ = _tenant_cfg(0)
+    strategy = get_strategy(cfg.strategy)
+    pools = []
+    for i in range(Tm):
+        x0, y0 = _points(cap, seed=500 + i, shift=0.3 * i)
+        mask = np.zeros(cap, bool)
+        mask[:6] = True
+        edges = trees_train.make_bins(jnp.asarray(x0), 8).edges
+        codes = trees_train.code_features(jnp.asarray(x0), edges)
+        tx, ty = _points(16, seed=550 + i)
+        # key/fit_key are SEEDS, not arrays: the single chunk donates its
+        # carried state (key included), so each arm builds fresh key buffers
+        pools.append(dict(
+            x=x0, y=y0, mask=mask, edges=edges, codes=np.asarray(codes),
+            tx=tx, ty=ty, key_seed=7 + i, fit_seed=90 + i,
+        ))
+
+    # arm 1: per-tenant single-device chunks (gemm — the mesh grid runs gemm
+    # too, so the arms share the eval kernel)
+    singles = []
+    for p in pools:
+        fit = make_device_fit(cfg, p["edges"], 48, 2)
+        chunk = make_chunk_fn(
+            strategy, window, K, fit, label_cap=cap, with_metrics=True,
+            n_classes=2,
+        )
+        state = state_lib.PoolState(
+            x=jnp.asarray(p["x"]), oracle_y=jnp.asarray(p["y"]),
+            labeled_mask=jnp.asarray(p["mask"]), key=jax.random.key(p["key_seed"]),
+            round=jnp.asarray(0, jnp.int32),
+            n_filled=jnp.asarray(cap, jnp.int32),
+        )
+        aux = StrategyAux(seed_mask=jnp.asarray(p["mask"]))
+        singles.append(chunk(
+            jnp.asarray(p["codes"]), state, aux, jax.random.key(p["fit_seed"]),
+            jnp.asarray(p["tx"]), jnp.asarray(p["ty"]),
+            jnp.asarray(K, jnp.int32),
+        ))
+
+    # arm 2: the tenant-axis chunk on the 4x2 mesh (the serve_multi/chunk
+    # program shape), tenants stacked on the dataset axis
+    grid_fit = make_grid_device_fit(cfg, 48, 2)
+    gchunk = make_grid_chunk_fn(
+        [strategy], window, K, grid_fit, n_datasets=Tm, n_seeds=1,
+        use_fill=True, use_test_fill=True, mesh=mesh, with_metrics=True,
+        n_classes=2,
+    )
+    row = NamedSharding(mesh, P(None, mesh_lib.AXIS_DATA))
+    row2 = NamedSharding(mesh, P(None, mesh_lib.AXIS_DATA, None))
+    rep = NamedSharding(mesh, P())
+    stack = lambda k: np.stack([p[k] for p in pools])  # noqa: E731
+    grid = SweepState(
+        labeled_mask=jax.device_put(stack("mask"), row),
+        key=mesh_lib.global_put(
+            jnp.stack([jax.random.key(p["key_seed"]) for p in pools]), mesh,
+            mesh_lib.replicated_spec(),
+        ),
+        round=jax.device_put(np.zeros(Tm, np.int32), rep),
+    )
+    out_grid, extras, ys = gchunk(
+        jax.device_put(stack("codes"), row2),
+        jax.device_put(stack("x"), row2),
+        jax.device_put(stack("y"), row),
+        grid,
+        jax.device_put(stack("mask"), row),
+        (None,),
+        mesh_lib.global_put(
+            jnp.stack([jax.random.key(p["fit_seed"]) for p in pools]), mesh,
+            mesh_lib.replicated_spec(),
+        ),
+        jax.device_put(np.full(Tm, window, np.int32), rep),
+        jax.device_put(stack("tx"), rep),
+        jax.device_put(stack("ty"), rep),
+        jax.device_put(np.full(Tm, K, np.int32), rep),
+        jax.device_put(np.full(Tm, cap, np.int32), rep),
+        jax.device_put(stack("edges"), rep),
+        jax.device_put(np.full(Tm, cap, np.int32), rep),
+        jax.device_put(np.full(Tm, 16, np.int32), rep),
+    )
+    for i, (st, ex, ys1) in enumerate(singles):
+        np.testing.assert_array_equal(
+            np.asarray(out_grid.labeled_mask)[i], np.asarray(st.labeled_mask)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(jax.random.key_data(out_grid.key))[i],
+            np.asarray(jax.random.key_data(st.key)),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(ys[1])[:, i], np.asarray(ys1[1])  # n_labeled per round
+        )
+        np.testing.assert_allclose(
+            np.asarray(ys[2])[:, i], np.asarray(ys1[2]), atol=1e-6  # accuracy
+        )
