@@ -2090,11 +2090,20 @@ def _trace_phases(profile_dir: str) -> dict:
     return mod.device_seconds_by_phase(profile_dir)
 
 
-def _audit_gate() -> dict:
+def _audit_gate(
+    pool_rows=None, mode="all", serve_pool=None, sweep_pool=None,
+    neural_pool=None, lal_pool=None, features=None, n_trees=None,
+    max_depth=None,
+) -> dict:
     """``--audit``: run the static program auditor over the full registry
-    before any bench body executes. Error findings raise (the except path
-    still prints the one JSON line, carrying the audit error); the clean
-    verdict rides the final payload under ``audit``."""
+    before any bench body executes, then the static memory planner over the
+    programs THIS MODE launches — each family priced at its OWN resolved
+    scale (the scoring pool, the sweep pool, the neural pool, the serve
+    slab; pricing a serve slab at the scoring pool's rows would overstate
+    its footprint ~35x on rig sizes and could spuriously refuse a bench
+    that fits). Error findings raise (the except path still prints the one
+    JSON line, carrying the audit error); the clean verdict — including
+    the ``memory`` section — rides the final payload under ``audit``."""
     import sys
 
     import jax
@@ -2105,6 +2114,7 @@ def _audit_gate() -> dict:
         lint_paths,
         run_audit,
     )
+    from distributed_active_learning_tpu.analysis import memory as memory_lib
 
     placements = None if len(jax.devices()) >= 8 else ["cpu"]
     report = run_audit(build_registry(placements=placements))
@@ -2116,11 +2126,66 @@ def _audit_gate() -> dict:
             "(findings on stderr; reproduce with "
             "`python -m distributed_active_learning_tpu.analysis`)"
         )
+    # Memory planning COMPILES each priced program, so it covers the
+    # programs THIS MODE launches (not the whole registry — the full-matrix
+    # gate is the tier-1 analysis job's `--memory` step), each group priced
+    # at ITS resolved scale (audit_shapes override): the 64-row registry
+    # stand-in's KiB footprint could never trip a GiB device budget, and
+    # the whole point is refusing the rig-size program that would die as
+    # r05 did.
+    budget = memory_lib.device_budget()
+    groups = []  # (build_registry kwargs, pool scale)
+    if mode in ("all", "round", "score", "density"):
+        groups.append((dict(
+            strategies=["uncertainty", "uncertainty-int8"],
+            kinds=["chunk", "fused_chunk", "fused_select"],
+            placements=placements,
+        ), pool_rows))
+    if mode in ("all", "sweep"):
+        groups.append((dict(
+            strategies=["uncertainty"], kinds=["sweep"],
+            placements=placements,
+        ), sweep_pool or pool_rows))
+    if mode in ("all", "grid"):
+        groups.append((dict(kinds=["grid"], placements=placements), pool_rows))
+    if mode in ("all", "neural"):
+        groups.append((dict(
+            strategies=["entropy"], kinds=["neural_chunk", "neural_sweep"],
+            placements=["cpu"],
+        ), neural_pool))
+    if mode in ("all", "lal"):
+        groups.append((dict(
+            strategies=["lal"], kinds=["chunk"], placements=placements,
+        ), lal_pool or pool_rows))
+    if mode in ("all", "serve"):
+        groups.append((dict(kinds=["serve"], placements=placements), serve_pool))
+    if mode in ("all", "serve-multi"):
+        groups.append((dict(
+            kinds=["serve_multi"], placements=placements,
+        ), serve_pool))
+    mem_table, mem_findings = {}, []
+    for kwargs, rows in groups:
+        t, f = memory_lib.price_specs(
+            build_registry(**kwargs), budget, pool_rows=rows,
+            features=features, n_trees=n_trees, max_depth=max_depth,
+        )
+        mem_table.update(t)
+        mem_findings.extend(f)
+    memory = memory_lib.memory_section(mem_table, mem_findings, budget)
+    if any(f.severity == "error" for f in mem_findings):
+        for f in mem_findings:
+            print(str(f), file=sys.stderr)
+        raise RuntimeError(
+            f"memory budget gate failed before benching: "
+            f"{memory['counts']} (findings on stderr; reproduce with "
+            "`python -m distributed_active_learning_tpu.analysis --memory`)"
+        )
     return {
         "programs_audited": len(report.programs),
         "programs_skipped": len(report.skipped),
         "counts": report.counts(),
         "max_severity": report.max_severity,
+        "memory": memory,
     }
 
 
@@ -2307,7 +2372,13 @@ def main():
             )
         cpu_sizes = _resolve_sizes(args)
         if args.audit:
-            audit_summary = _audit_gate()
+            audit_summary = _audit_gate(
+                pool_rows=args.pool, mode=args.mode,
+                serve_pool=args.serve_pool, sweep_pool=args.sweep_pool,
+                neural_pool=args.neural_pool, lal_pool=args.lal_pool,
+                features=args.features, n_trees=args.trees,
+                max_depth=args.depth,
+            )
         if args.profile_dir:
             # Whole-suite jax.profiler capture; afterwards the trace's
             # op-level timeline folds back onto the named_scope phase names

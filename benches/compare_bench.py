@@ -112,6 +112,14 @@ DEFAULT_SPECS: List[MetricSpec] = [
         hard=True,
     ),
     MetricSpec("chunk_jit_cache_entries", "lower", 0.0, kind="counter"),
+    # the audit surface itself: a payload that audited FEWER programs than
+    # its baseline means the registry silently shrank (a kind dropped, a
+    # builder broken into skip) — caught here even if the hand-maintained
+    # CI floor assert lags behind. Lifted from the nested `audit` section
+    # by compare_payloads; any decrease fires, hard.
+    MetricSpec(
+        "programs_audited", "higher", 0.0, kind="counter", hard=True
+    ),
 ]
 
 #: "value" is mode-dependent; it only compares when both payloads agree on
@@ -190,6 +198,22 @@ def compare_payloads(
     that stopped running) is visible rather than silently uncompared.
     """
     findings, skipped, notes = [], [], []
+    # bench payloads nest the audit verdict under "audit" (bench.py
+    # _audit_gate); lift its counter to the top level so the spec table —
+    # which reads flat keys — can compare it. Copies, never mutates the
+    # caller's dicts.
+    lifted = []
+    for payload in (baseline, current):
+        p = dict(payload)
+        audit = p.get("audit")
+        if (
+            isinstance(audit, dict)
+            and isinstance(audit.get("programs_audited"), int)
+            and "programs_audited" not in p
+        ):
+            p["programs_audited"] = audit["programs_audited"]
+        lifted.append(p)
+    baseline, current = lifted
     if bool(baseline.get("cpu_smoke_sizes")) != bool(current.get("cpu_smoke_sizes")):
         notes.append(
             "size tables differ (cpu_smoke_sizes mismatch): one side ran "

@@ -84,6 +84,25 @@ def build_parser() -> argparse.ArgumentParser:
         "quick look",
     )
     ap.add_argument(
+        "--memory", action="store_true",
+        help="static memory planner (analysis/memory.py): AOT-compile each "
+        "selected program, normalize compiled.memory_analysis() into peak "
+        "HBM (args + temps + outputs + generated code - donation credit), "
+        "estimate the "
+        "pallas megakernel's VMEM tile set, and gate both against the "
+        "device budget (or --budget-table). Error findings "
+        "(hbm-over-budget / vmem-over-budget) fail the gate like audit "
+        "findings do. Like --costs this COMPILES every selected program; "
+        "filter with --kinds/--strategies for a quick look",
+    )
+    ap.add_argument(
+        "--budget-table", default=None, metavar="PATH",
+        help="JSON memory budget overriding the per-chip tables: "
+        '{"hbm_bytes": N, "vmem_bytes": N} (either may be null to disable '
+        "that axis; optional \"source\" labels findings). The tier-1 "
+        "analysis job passes the committed CPU table",
+    )
+    ap.add_argument(
         "--list", action="store_true", help="list auditable programs and exit"
     )
     ap.add_argument(
@@ -102,11 +121,16 @@ def main(argv=None) -> int:
     from distributed_active_learning_tpu.analysis.report import Report
 
     if args.rules:
+        from distributed_active_learning_tpu.analysis.memory import MEMORY_RULES
+
         print("jaxpr rules:")
         for rule in rules_lib.default_rules():
             print(f"  {rule.id:28s} [{rule.severity}] {rule.description}")
         print("lint rules:")
         for rule_id, severity, desc in lint_lib.iter_rule_table():
+            print(f"  {rule_id:28s} [{severity}] {desc}")
+        print("memory rules:")
+        for rule_id, (severity, desc) in MEMORY_RULES.items():
             print(f"  {rule_id:28s} [{severity}] {desc}")
         return 0
 
@@ -134,6 +158,27 @@ def main(argv=None) -> int:
         else:
             print(render_cost_table(table))
         return 0
+
+    if args.memory:
+        import json
+
+        from distributed_active_learning_tpu.analysis import memory as memory_lib
+
+        budget = (
+            memory_lib.load_budget_table(args.budget_table)
+            if args.budget_table
+            else memory_lib.device_budget()
+        )
+        table, findings = memory_lib.memory_table(specs, budget)
+        section = memory_lib.memory_section(table, findings, budget)
+        if args.json:
+            print(json.dumps({"schema": 1, "memory": section}))
+        else:
+            print(memory_lib.render_memory_table(table, budget))
+            for f in findings:
+                print(str(f))
+        gating = Report(findings=list(findings))
+        return 1 if gating.gate(args.fail_on) else 0
 
     if args.no_audit:
         report = Report()

@@ -45,6 +45,22 @@ class AuditUnit:
     # Quantized forest storage this program was built with ("bf16"/"int8");
     # None = unquantized. The quantized-leaf-upcast rule fires on it.
     quantize: Optional[str] = None
+    # Pool scale of this program's audit shapes: any aval dim >= pool_rows
+    # is "pool-sized" to the sharding rules (replicated-pool-operand /
+    # pool-scale-collective). None disables them — single-device programs
+    # have no sharding contract to audit.
+    pool_rows: Optional[int] = None
+    # Per-launch collective traffic ceiling in bytes (scan trip counts
+    # multiplied in). None derives the default: N x the largest input
+    # operand — a program whose collectives move more than a few pools'
+    # worth of data per launch is the r4-style bandwidth cliff regardless
+    # of which primitive moved it.
+    collective_bytes_budget: Optional[float] = None
+    # Megakernel tile parameters ({n_trees, max_depth, n_rows, features,
+    # window, quantize}) for programs that wrap the pallas round kernel;
+    # the memory planner's VMEM estimator prices them. None = no pallas
+    # tile claim (gemm/gather paths).
+    pallas_tiles: Optional[dict] = None
 
 
 class TracedUnit:
@@ -59,6 +75,9 @@ class TracedUnit:
         self.expect_donation = unit.expect_donation
         self.with_metrics = unit.with_metrics
         self.quantize = unit.quantize
+        self.pool_rows = unit.pool_rows
+        self.pallas_tiles = unit.pallas_tiles
+        self.collective_bytes_budget = unit.collective_bytes_budget
         self._traced = unit.fn.trace(*unit.args)
         self._eqn_sites = None
         self._avals = None
@@ -141,11 +160,18 @@ class TracedUnit:
 
 
 def audit_unit(
-    unit: AuditUnit, rules: Optional[Sequence[rules_lib.Rule]] = None
+    unit: AuditUnit,
+    rules: Optional[Sequence[rules_lib.Rule]] = None,
+    stats: Optional[dict] = None,
 ) -> List[Finding]:
     """Trace one program and run every rule over it. A program that fails to
     TRACE is itself an error finding — an untraceable registered program
-    means the audit surface regressed, not that the program is clean."""
+    means the audit surface regressed, not that the program is clean.
+
+    ``stats`` (optional dict) receives the program's accounting the rules
+    compute as a side effect — today the per-launch collective traffic
+    (``collective_bytes``, ``collective_sites``) — so reports can carry the
+    numbers, not just the verdicts."""
     try:
         traced = TracedUnit(unit)
     except Exception as e:  # noqa: BLE001 - converted into a finding
@@ -161,6 +187,10 @@ def audit_unit(
     findings: List[Finding] = []
     for rule in rules or rules_lib.default_rules():
         findings.extend(rule.check(traced))
+    if stats is not None:
+        traffic = rules_lib.collective_traffic(traced)
+        stats["collective_bytes"] = float(sum(b for _, b in traffic))
+        stats["collective_sites"] = len(traffic)
     return findings
 
 
@@ -194,5 +224,8 @@ def run_audit(
             )
             continue
         report.programs.append(spec.name)
-        report.extend(audit_unit(unit, rules=rules))
+        stats: dict = {}
+        report.extend(audit_unit(unit, rules=rules, stats=stats))
+        if stats.get("collective_sites"):
+            report.stats[spec.name] = stats
     return report

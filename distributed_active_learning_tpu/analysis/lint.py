@@ -19,6 +19,31 @@ tracing — values that leak host round-trips or silent retraces:
   hash by insertion order; two equal configs built in different orders then
   miss the jit cache and recompile. Use ``sorted(d.items())``.
 
+The DAL2xx series is the HOST-CONCURRENCY lint, scoped to the threaded
+surfaces (``serving/`` + ``runtime/`` — the frontend dispatcher, the tenant
+manager, the AOT precompile worker, telemetry). The jaxpr auditor cannot see
+these: they are races between Python threads AROUND the traced programs.
+
+- ``DAL201 guarded-attr-mutated-outside-lock``: a class that guards an
+  attribute with ``with self._lock:`` somewhere must guard EVERY mutation of
+  it — one unguarded ``self.x += 1`` on another thread and the counter (or
+  the installed executable) silently corrupts. ``__init__`` is exempt
+  (construction is single-threaded by convention).
+- ``DAL202 dispatch-under-lock``: a ``jax.*``/``jnp.*`` call (or
+  ``block_until_ready``) inside a ``with self._lock:`` block keeps every
+  other thread out of the manager for a device dispatch's duration — the
+  frontend's fairness and admission latency all stall behind it.
+- ``DAL203 non-atomic-install``: a membership test (``k in self.d`` /
+  ``self.d.get(k)``) and a subscript store (``self.d[k] = v``) on the same
+  guarded dict in one function but NOT in one ``with self._lock:`` block is
+  the check-then-act race — two threads both miss, both build, and one
+  executable install silently overwrites the other (the AOT precompile
+  worker's exact hazard).
+- ``DAL204 thread-without-discipline``: ``threading.Thread(...)`` in a
+  module with neither a ``.join(...)`` call nor an ``atexit.register``
+  hook — a worker aborted mid-XLA-compile at interpreter teardown takes the
+  whole process down ("terminate called without an active exception").
+
 Waivers: append ``# audit: ok`` (any rule) or ``# audit: ok[DAL101]`` (one
 rule) to the offending line — any line of a multi-line call works. For
 DAL103 (whose finding anchors to the jitted function itself) put the waiver
@@ -29,6 +54,7 @@ deliberately ignored, so one comment can't blanket a whole function.
 from __future__ import annotations
 
 import ast
+import dataclasses
 import os
 import re
 from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
@@ -40,7 +66,23 @@ LINT_RULES: Dict[str, Tuple[str, str]] = {
     "DAL102": ("error", "float()/int()/bool() on a traced value inside jit"),
     "DAL103": ("warn", "jitted function closes over a mutated enclosing name"),
     "DAL104": ("warn", "tuple(dict.items()) hashes by insertion order"),
+    # host-concurrency series (serving/ + runtime/ — the threaded surfaces)
+    "DAL201": ("error", "lock-guarded attribute mutated outside its lock"),
+    "DAL202": ("warn", "jax/jnp dispatch while holding a shared lock"),
+    "DAL203": ("error", "non-atomic check-then-install on a guarded dict"),
+    "DAL204": ("warn", "threading.Thread without join/atexit discipline"),
 }
+
+#: Relative-path prefixes the DAL2xx concurrency rules apply to: the
+#: threaded surfaces. The DAL1xx recompile hazards run everywhere the
+#: targets list reaches; concurrency findings outside threaded code would
+#: be noise (a CLI script mutating its own attrs has no second thread).
+CONCURRENCY_SCOPES = ("serving/", "runtime/")
+
+#: Lock-ish types whose self-attribute instances define a guard:
+#: ``self._lock = threading.Lock()`` etc. Condition counts — the frontend
+#: uses one as its queue mutex.
+_LOCK_TYPES = ("Lock", "RLock", "Condition")
 
 _WAIVER_RE = re.compile(r"#\s*audit:\s*ok(?:\[(?P<rules>[A-Z0-9,\s]+)\])?")
 
@@ -270,6 +312,289 @@ class _Linter(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+# ---------------------------------------------------------------------------
+# DAL2xx: host-concurrency lint (class-scope analysis)
+# ---------------------------------------------------------------------------
+
+
+def _self_attr(expr: ast.expr) -> Optional[str]:
+    """``self.X`` -> ``"X"``; anything else -> None."""
+    if (
+        isinstance(expr, ast.Attribute)
+        and isinstance(expr.value, ast.Name)
+        and expr.value.id == "self"
+    ):
+        return expr.attr
+    return None
+
+
+def _lock_attrs_of(cls: ast.ClassDef) -> Set[str]:
+    """Attribute names the class binds to threading.Lock/RLock/Condition."""
+    out: Set[str] = set()
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not isinstance(node.value, ast.Call):
+            continue
+        name = _dotted(node.value.func)
+        if name.split(".")[-1] not in _LOCK_TYPES:
+            continue
+        for target in node.targets:
+            attr = _self_attr(target)
+            if attr is not None:
+                out.add(attr)
+    return out
+
+
+def _with_lock_attr(node: ast.With, lock_attrs: Set[str]) -> Optional[str]:
+    """The lock attr a ``with self._lock:`` statement holds, or None."""
+    for item in node.items:
+        attr = _self_attr(item.context_expr)
+        if attr in lock_attrs:
+            return attr
+    return None
+
+
+@dataclasses.dataclass
+class _AttrEvent:
+    """One touch of ``self.<attr>`` inside a method: what happened
+    (``mutate`` = assignment/augassign/del of the attr or one of its
+    subscripts; ``test`` = membership test / ``.get()``; ``store`` =
+    subscript store) and which with-lock block (by id) enclosed it."""
+
+    kind: str
+    attr: str
+    node: ast.AST
+    lock_block: Optional[int]
+
+
+def _method_events(fn: ast.AST, lock_attrs: Set[str]) -> List[_AttrEvent]:
+    events: List[_AttrEvent] = []
+
+    def walk(node: ast.AST, lock_block: Optional[int]):
+        for child in ast.iter_child_nodes(node):
+            inner = lock_block
+            if isinstance(child, ast.With) and _with_lock_attr(child, lock_attrs):
+                inner = id(child)
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue  # nested defs run later, on their own thread terms
+            if isinstance(child, (ast.Assign, ast.AugAssign)):
+                targets = (
+                    child.targets if isinstance(child, ast.Assign)
+                    else [child.target]
+                )
+                # tuple/list targets unpack: `self.a, self.b = ...` mutates
+                # both — flattening keeps the race rule from missing them
+                flat = []
+                for t in targets:
+                    if isinstance(t, (ast.Tuple, ast.List)):
+                        flat.extend(t.elts)
+                    else:
+                        flat.append(t)
+                for t in flat:
+                    attr = _self_attr(t)
+                    if attr is not None:
+                        events.append(_AttrEvent("mutate", attr, child, inner))
+                    elif isinstance(t, ast.Subscript):
+                        attr = _self_attr(t.value)
+                        if attr is not None:
+                            events.append(
+                                _AttrEvent("mutate", attr, child, inner)
+                            )
+                            events.append(
+                                _AttrEvent("store", attr, child, inner)
+                            )
+            if isinstance(child, ast.Delete):
+                for t in child.targets:
+                    attr = _self_attr(t) or (
+                        _self_attr(t.value)
+                        if isinstance(t, ast.Subscript) else None
+                    )
+                    if attr is not None:
+                        events.append(_AttrEvent("mutate", attr, child, inner))
+            # membership tests: `k in self.d` / `k not in self.d`
+            if isinstance(child, ast.Compare) and any(
+                isinstance(op, (ast.In, ast.NotIn)) for op in child.ops
+            ):
+                for comp in child.comparators:
+                    attr = _self_attr(comp)
+                    if attr is not None:
+                        events.append(_AttrEvent("test", attr, child, inner))
+            # `self.d.get(k)` is the other spelling of the membership test
+            if (
+                isinstance(child, ast.Call)
+                and isinstance(child.func, ast.Attribute)
+                and child.func.attr == "get"
+            ):
+                attr = _self_attr(child.func.value)
+                if attr is not None:
+                    events.append(_AttrEvent("test", attr, child, inner))
+            walk(child, inner)
+
+    walk(fn, None)
+    return events
+
+
+def _lint_concurrency(linter: "_Linter", tree: ast.Module) -> None:
+    """The DAL2xx pass: module-level thread discipline + per-class lock
+    discipline. Runs only on files under :data:`CONCURRENCY_SCOPES`."""
+    # DAL204: Thread constructions in a module with no join/atexit exit path.
+    # A `.join` only counts when its receiver is plausibly a THREAD — the
+    # name a threading.Thread(...) was assigned to, or a thread/worker-named
+    # variable — otherwise any `"\n".join(lines)` would silence the rule
+    # module-wide.
+    thread_names: Set[str] = set()
+    for n in ast.walk(tree):
+        if not (
+            isinstance(n, ast.Assign)
+            and isinstance(n.value, ast.Call)
+            and _dotted(n.value.func) in ("threading.Thread", "Thread")
+        ):
+            continue
+        for target in n.targets:
+            if isinstance(target, ast.Name):
+                thread_names.add(target.id)
+            attr = _self_attr(target)
+            if attr is not None:
+                thread_names.add(attr)
+
+    def _joins_a_thread(call: ast.Call) -> bool:
+        if not (
+            isinstance(call.func, ast.Attribute) and call.func.attr == "join"
+        ):
+            return False
+        recv = call.func.value
+        name = (
+            recv.id if isinstance(recv, ast.Name)
+            else recv.attr if isinstance(recv, ast.Attribute)
+            else ""
+        )
+        return name in thread_names or bool(
+            re.search(r"thread|worker", name, re.IGNORECASE)
+        )
+
+    has_join = any(
+        isinstance(n, ast.Call) and _joins_a_thread(n)
+        for n in ast.walk(tree)
+    )
+    has_atexit = any(
+        isinstance(n, ast.Call) and _dotted(n.func) == "atexit.register"
+        for n in ast.walk(tree)
+    ) or any(
+        isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        and any(_dotted(d) == "atexit.register" for d in n.decorator_list)
+        for n in ast.walk(tree)
+    )
+    if not (has_join or has_atexit):
+        for n in ast.walk(tree):
+            if isinstance(n, ast.Call) and _dotted(n.func) in (
+                "threading.Thread", "Thread"
+            ):
+                linter._emit(
+                    "DAL204", n,
+                    "threading.Thread started in a module with no .join() "
+                    "and no atexit.register hook — a worker aborted "
+                    "mid-compile at interpreter teardown kills the process",
+                )
+
+    for cls in [n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)]:
+        lock_attrs = _lock_attrs_of(cls)
+        if not lock_attrs:
+            continue
+        methods = [
+            m for m in cls.body
+            if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        per_method = {m.name: _method_events(m, lock_attrs) for m in methods}
+        # attrs the class treats as lock-guarded: mutated under a with-lock
+        # ANYWHERE in the class (lexically — a helper that only runs with
+        # the lock already held must carry a waiver, which documents the
+        # calling convention right at the mutation site)
+        guarded = {
+            ev.attr
+            for events in per_method.values()
+            for ev in events
+            if ev.kind == "mutate" and ev.lock_block is not None
+        } - lock_attrs
+        for method in methods:
+            events = per_method[method.name]
+            if method.name != "__init__":
+                for ev in events:
+                    if (
+                        ev.kind == "mutate"
+                        and ev.attr in guarded
+                        and ev.lock_block is None
+                    ):
+                        linter._emit(
+                            "DAL201", ev.node,
+                            f"`self.{ev.attr}` is mutated under "
+                            f"`with self.<lock>:` elsewhere in "
+                            f"{cls.name} but mutated here without it — "
+                            "one unguarded writer corrupts the shared state",
+                        )
+            # DAL203: test + store on one guarded dict, not in ONE block
+            attrs = {ev.attr for ev in events if ev.kind == "store"}
+            for attr in attrs & guarded:
+                tests = [
+                    ev for ev in events
+                    if ev.kind == "test" and ev.attr == attr
+                ]
+                stores = [
+                    ev for ev in events
+                    if ev.kind == "store" and ev.attr == attr
+                ]
+                for store in stores:
+                    split = [
+                        t for t in tests
+                        if t.lock_block is None
+                        or store.lock_block is None
+                        or t.lock_block != store.lock_block
+                    ]
+                    if tests and len(split) == len(tests):
+                        linter._emit(
+                            "DAL203", store.node,
+                            f"`self.{attr}[...] = ...` and its membership "
+                            "test sit in different lock scopes — two "
+                            "threads can both miss and one install "
+                            "silently overwrites the other; test and "
+                            "store inside ONE `with self.<lock>:` block",
+                        )
+        # DAL202: device dispatch inside any with-lock block. Nested
+        # def/lambda bodies are skipped — a callback merely DEFINED under
+        # the lock runs later, after release, on its own thread's terms.
+        def _calls_skipping_nested_defs(node: ast.AST):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+                ):
+                    continue
+                if isinstance(child, ast.Call):
+                    yield child
+                yield from _calls_skipping_nested_defs(child)
+
+        for method in methods:
+            for node in ast.walk(method):
+                if not (
+                    isinstance(node, ast.With)
+                    and _with_lock_attr(node, lock_attrs)
+                ):
+                    continue
+                for call in _calls_skipping_nested_defs(node):
+                    name = _dotted(call.func)
+                    root = name.split(".")[0]
+                    is_dispatch = root in ("jax", "jnp") or (
+                        isinstance(call.func, ast.Attribute)
+                        and call.func.attr == "block_until_ready"
+                    )
+                    if is_dispatch:
+                        linter._emit(
+                            "DAL202", call,
+                            f"`{name or 'block_until_ready'}` runs while "
+                            f"holding a {cls.name} lock — every other "
+                            "thread stalls behind the device dispatch",
+                        )
+
+
 def lint_file(path: str, relpath: Optional[str] = None) -> List[Finding]:
     rel = relpath or os.path.basename(path)
     with open(path) as f:
@@ -288,16 +613,29 @@ def lint_file(path: str, relpath: Optional[str] = None) -> List[Finding]:
         ]
     linter = _Linter(rel, source)
     linter.visit(tree)
+    # The concurrency scope reads the relpath prefix OR the file's OWN
+    # parent directory: a caller linting serving/tenants.py under a bare
+    # basename relpath (lint_file with no rel, a single-dir lint_paths
+    # whose commonpath lands inside serving/) must still get the DAL2xx
+    # pass. Only the immediate parent counts — matching every ancestor
+    # component would turn a checkout under /home/ci/runtime/... into a
+    # machine-wide concurrency lint of unthreaded files.
+    rel_scoped = rel.replace(os.sep, "/").startswith(CONCURRENCY_SCOPES)
+    parent = os.path.basename(os.path.dirname(os.path.abspath(path)))
+    path_scoped = any(parent == s.rstrip("/") for s in CONCURRENCY_SCOPES)
+    if rel_scoped or path_scoped:
+        _lint_concurrency(linter, tree)
     return linter.findings
 
 
 def default_lint_targets(root: Optional[str] = None) -> List[str]:
-    """The driver surfaces the recompile hazards live in: ``runtime/`` and
-    ``strategies/`` of the installed package."""
+    """The driver surfaces the recompile hazards live in (``runtime/``,
+    ``strategies/``) plus the threaded serving layer the DAL2xx
+    concurrency rules exist for (``serving/``)."""
     if root is None:
         root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     targets = []
-    for sub in ("runtime", "strategies"):
+    for sub in ("runtime", "serving", "strategies"):
         d = os.path.join(root, sub)
         for fn in sorted(os.listdir(d)):
             if fn.endswith(".py"):

@@ -1,0 +1,485 @@
+"""Static memory planner: price every registered program's footprint BEFORE
+the launch, and refuse the ones that cannot fit.
+
+The repo's open wound is runs that die on the TPU rig with nothing to show
+(BENCH_r05: rc 124, ``parsed: null``) — and the ROADMAP's next pushes
+(pod-scale pool sharding, multi-tenant hardening) add exactly the failure
+modes that kill a run silently: a pool-sized buffer materializing replicated,
+a grown slab capacity whose chunk program no longer fits beside the resident
+tenants, an over-tiled pallas kernel. This module makes those a NAMED
+pre-flight failure, in the same registry/finding vocabulary as the PR-6
+auditor:
+
+- **Peak HBM**: every program the registry (analysis/programs.py) can build
+  is AOT-lowered and compiled, and ``compiled.memory_analysis()`` is
+  normalized into one peak-footprint number — arguments + outputs + temps
+  + generated code (the compiled executable itself lives in HBM too, and
+  nonzero on TPU), MINUS the aliased-donation credit (a donated carry's
+  output bytes reuse its argument buffer; double-counting them would flag
+  every donation-disciplined chunk as 2x its real size). Findings break the
+  peak into exactly these five components so the named overage always
+  reconciles against its parts.
+
+- **VMEM**: XLA's memory stats do not see inside a pallas kernel, so the
+  megakernel's VMEM working set is estimated from the SAME tile arithmetic
+  the kernel tiles with (``ops/trees_pallas.tile_dims`` + the operand
+  layouts of ``ops/round_fused``): the resident x tile, the per-tree-block
+  forest operands, the penalty row, the vote scratch, and the top-k window.
+  The estimate is placement-independent, so the CPU rig can gate the TPU
+  kernel's tiling before the TPU ever sees it.
+
+- **Budgets**: per-chip capacity tables live next to the roofline's peak
+  tables (``analysis/roofline.py`` ``HBM_BYTES_PER_DEVICE`` /
+  ``VMEM_BYTES_PER_CORE``), looked up by device kind like MFU peaks are; a
+  JSON budget table (``--budget-table``, the CI route) overrides them —
+  format ``{"hbm_bytes": N, "vmem_bytes": N}``, with an optional ``"source"``
+  label.
+
+Over-budget programs yield ERROR findings (``hbm-over-budget`` /
+``vmem-over-budget``) with the overage named, through the same
+:class:`~analysis.report.Finding` plumbing every other rule uses — so
+``run.py --audit`` refuses the launch, ``bench.py --audit`` carries the
+``memory`` section in its payload, and ``python -m ...analysis --memory``
+gates CI. Unlike the jaxpr rules this layer COMPILES each program (one AOT
+``lower().compile()`` per spec, like ``--costs``); it is therefore opt-in
+per surface, never part of the trace-only audit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from distributed_active_learning_tpu.analysis import roofline
+from distributed_active_learning_tpu.analysis.report import Finding
+
+#: The planner's finding vocabulary (severity, description) — kept here
+#: rather than rules.py because these fire from compiled memory stats, not
+#: from a jaxpr walk; ``--rules`` prints both registries.
+MEMORY_RULES: Dict[str, Tuple[str, str]] = {
+    "hbm-over-budget": (
+        "error",
+        "a program's peak HBM footprint (args + temps + outputs + generated "
+        "code - donation credit) exceeds the device budget — the launch would OOM",
+    ),
+    "vmem-over-budget": (
+        "error",
+        "the pallas megakernel's resident tile set exceeds the per-core "
+        "VMEM budget — the kernel would fail to schedule on the TPU",
+    ),
+    "memory-plan-unavailable": (
+        "warn",
+        "a registered program could not be compiled for memory planning "
+        "(its footprint is unpriced, not over budget)",
+    ),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class MemoryBudget:
+    """What one device may spend: HBM capacity and per-core VMEM, in bytes.
+    ``None`` disables that axis (unknown chip — footprints still report)."""
+
+    hbm_bytes: Optional[float]
+    vmem_bytes: Optional[float]
+    source: str
+
+    def asdict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def device_budget(kind: Optional[str] = None) -> MemoryBudget:
+    """The budget for this (or the named) device kind, from the roofline's
+    capacity tables."""
+    hbm, kind = roofline.hbm_capacity(kind)
+    vmem, _ = roofline.vmem_capacity(kind)
+    return MemoryBudget(hbm_bytes=hbm, vmem_bytes=vmem, source=kind)
+
+
+def load_budget_table(path: str) -> MemoryBudget:
+    """A JSON budget table: ``{"hbm_bytes": N, "vmem_bytes": N}`` (either
+    key may be absent/null to disable that axis; ``"source"`` labels the
+    table in findings, defaulting to the file path)."""
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict):
+        raise ValueError(f"{path}: budget table must be a JSON object")
+    unknown = set(doc) - {"hbm_bytes", "vmem_bytes", "source"}
+    if unknown:
+        raise ValueError(
+            f"{path}: unknown budget keys {sorted(unknown)}; the table "
+            "format is {\"hbm_bytes\": N, \"vmem_bytes\": N}"
+        )
+
+    def _num(key):
+        v = doc.get(key)
+        if v is None:
+            return None
+        if not isinstance(v, (int, float)) or isinstance(v, bool) or v <= 0:
+            raise ValueError(f"{path}: {key} must be a positive number, got {v!r}")
+        return float(v)
+
+    return MemoryBudget(
+        hbm_bytes=_num("hbm_bytes"),
+        vmem_bytes=_num("vmem_bytes"),
+        source=str(doc.get("source", path)),
+    )
+
+
+# ---------------------------------------------------------------------------
+# peak HBM from compiled memory stats
+# ---------------------------------------------------------------------------
+
+_STAT_KEYS = (
+    ("argument_size_in_bytes", "argument_bytes"),
+    ("output_size_in_bytes", "output_bytes"),
+    ("temp_size_in_bytes", "temp_bytes"),
+    ("alias_size_in_bytes", "alias_bytes"),
+    ("generated_code_size_in_bytes", "generated_code_bytes"),
+)
+
+
+def compiled_memory(compiled) -> Dict[str, Optional[float]]:
+    """Normalize ``compiled.memory_analysis()`` into a flat dict with
+    ``peak_hbm_bytes`` = args + outputs + temps + code - alias credit.
+
+    Multi-partition shapes (a list of per-partition stats) report the WORST
+    partition — the budget is per device, and the binding constraint is the
+    fullest one. Backends that report nothing return all-None, never 0 (a
+    zero would read as "free program" at the gate).
+    """
+    out: Dict[str, Optional[float]] = {name: None for _, name in _STAT_KEYS}
+    out["peak_hbm_bytes"] = None
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        return out
+    parts = ma if isinstance(ma, (list, tuple)) else [ma]
+    peaks = []
+    for part in parts:
+        vals = {}
+        for attr, name in _STAT_KEYS:
+            v = getattr(part, attr, None)
+            vals[name] = float(v) if isinstance(v, (int, float)) else None
+        if all(vals[n] is None for _, n in _STAT_KEYS):
+            continue
+        peak = (
+            (vals["argument_bytes"] or 0.0)
+            + (vals["output_bytes"] or 0.0)
+            + (vals["temp_bytes"] or 0.0)
+            + (vals["generated_code_bytes"] or 0.0)
+            - (vals["alias_bytes"] or 0.0)
+        )
+        peaks.append((peak, vals))
+    if not peaks:
+        return out
+    peak, vals = max(peaks, key=lambda p: p[0])
+    out.update(vals)
+    out["peak_hbm_bytes"] = peak
+    return out
+
+
+def program_memory(fn, *args) -> Dict[str, Optional[float]]:
+    """Peak-footprint stats of one jitted program at these (abstract or
+    concrete) argument shapes. Pays one AOT compile, like
+    :func:`~analysis.roofline.program_cost` — strictly outside timed
+    regions. Raises on programs that fail to lower/compile;
+    :func:`memory_table` converts that into a warn finding."""
+    return compiled_memory(fn.lower(*args).compile())
+
+
+# ---------------------------------------------------------------------------
+# VMEM: the megakernel's resident tile set
+# ---------------------------------------------------------------------------
+
+#: Storage bytes per element by quantize mode: thresholds narrow to bf16
+#: under BOTH quantized modes (lossless for binned splits); leaf stats are
+#: the mode's namesake width.
+_THR_BYTES = {"none": 4, "bf16": 2, "int8": 2}
+_VAL_BYTES = {"none": 4, "bf16": 2, "int8": 1}
+
+
+def megakernel_vmem(tiles: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """Estimate the fused-round megakernel's VMEM working set from its tile
+    parameters: ``{n_trees, max_depth, n_rows, features, window, quantize}``.
+
+    Mirrors the operand layout of ``ops/round_fused._megakernel`` over the
+    padded dims ``ops/trees_pallas.tile_dims`` computes: the transposed x
+    tile, the per-tree-block forest operands (one-hot selector, thresholds,
+    path matrix, leaf targets/values), the penalty row, the vote scratch,
+    and the padded top-k output rows. Returns ``None`` when the shapes
+    exceed the kernel's own tiling budget (``tile_dims`` declines and the
+    runtime falls back to the exact GEMM stream — no VMEM claim to price).
+    """
+    import types
+
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_active_learning_tpu.ops import trees_pallas
+
+    depth = int(tiles["max_depth"])
+    t = int(tiles["n_trees"])
+    n = int(tiles["n_rows"])
+    d = int(tiles["features"])
+    k = int(tiles["window"])
+    quantize = str(tiles.get("quantize") or "none")
+    n_internal = 2 ** depth - 1
+    n_leaves = 2 ** depth
+    # tile_dims only reads shapes; a shape-only stand-in avoids building a
+    # real forest just to ask how it would tile
+    gf = types.SimpleNamespace(
+        feat_ids=jax.ShapeDtypeStruct((t, n_internal), jnp.int32),
+        value=jax.ShapeDtypeStruct((t, n_leaves), jnp.float32),
+    )
+    dims = trees_pallas.tile_dims(gf, n, d)
+    if dims is None:
+        return None
+    i_pad, l_pad, d_pad, bn = dims
+    bt = trees_pallas._BT
+    k_pad = max(-(-k // 128) * 128, 128)
+    thr_b = _THR_BYTES.get(quantize, 4)
+    val_b = _VAL_BYTES.get(quantize, 4)
+    components = {
+        "x_tile": d_pad * bn * 2,                 # [d_pad, bn] bf16
+        "selector_tile": bt * i_pad * d_pad * 2,  # [BT*i_pad, d_pad] bf16
+        "threshold_tile": bt * i_pad * thr_b,     # [BT, i_pad]
+        "path_tile": bt * l_pad * i_pad * 1,      # [BT, l_pad, i_pad] int8
+        "target_tile": bt * l_pad * 4,            # [BT, l_pad] f32
+        "value_tile": bt * l_pad * val_b,         # [BT, l_pad]
+        "penalty_row": bn * 4,                    # [1, bn] f32
+        "vote_scratch": bn * 4,                   # [1, bn] f32 scratch
+        "topk_out": 2 * k_pad * 4,                # vals f32 + idx i32 rows
+    }
+    return {
+        "vmem_bytes": float(sum(components.values())),
+        "tile_dims": {
+            "i_pad": i_pad, "l_pad": l_pad, "d_pad": d_pad, "bn": bn,
+            "k_pad": k_pad, "tree_block": bt,
+        },
+        "components": components,
+    }
+
+
+# ---------------------------------------------------------------------------
+# the planner: per-spec table + findings
+# ---------------------------------------------------------------------------
+
+def _mib(b: float) -> str:
+    return f"{b / (1 << 20):.2f} MiB"
+
+
+def _finding(rule: str, program: str, message: str) -> Finding:
+    severity, _ = MEMORY_RULES[rule]
+    return Finding(
+        rule=rule, severity=severity, program=program,
+        location="<memory>", message=message,
+    )
+
+
+def memory_table(
+    specs: Sequence,
+    budget: MemoryBudget,
+) -> Tuple[Dict[str, Dict[str, Any]], List[Finding]]:
+    """Price every registry program against ``budget``.
+
+    Returns ``(table, findings)``: one table entry per spec — peak-HBM
+    stats, the VMEM estimate for pallas-tiled programs, and per-axis
+    ``*_over_budget_bytes`` when a budget fires — plus the findings
+    (``hbm-over-budget`` / ``vmem-over-budget`` errors with the overage
+    named; compile failures are warn findings, skipped builders plain
+    entries, so the table never silently drops a registered program).
+    """
+    from distributed_active_learning_tpu.analysis.programs import SkipProgram
+
+    table: Dict[str, Dict[str, Any]] = {}
+    findings: List[Finding] = []
+    for spec in specs:
+        try:
+            unit = spec.build()
+        except SkipProgram as skip:
+            table[spec.name] = {"skipped": str(skip)}
+            continue
+        except Exception as e:  # noqa: BLE001 — per-program, keep pricing
+            table[spec.name] = {"error": f"{type(e).__name__}: {e}"}
+            findings.append(_finding(
+                "memory-plan-unavailable", spec.name,
+                f"builder failed: {type(e).__name__}: {e}",
+            ))
+            continue
+        try:
+            entry: Dict[str, Any] = dict(program_memory(unit.fn, *unit.args))
+        except Exception as e:  # noqa: BLE001 — compile failure != over budget
+            table[spec.name] = {"error": f"{type(e).__name__}: {e}"}
+            findings.append(_finding(
+                "memory-plan-unavailable", spec.name,
+                f"lower/compile failed: {type(e).__name__}: {e}",
+            ))
+            continue
+        peak = entry.get("peak_hbm_bytes")
+        if peak is None:
+            # the backend compiled the program but reported no memory stats
+            # — the gate checked NOTHING for it; that must surface as a
+            # warn finding and an unpriced entry, never as priced-and-clean
+            # (the silent-green path this planner exists to close)
+            entry["unpriced"] = True
+            findings.append(_finding(
+                "memory-plan-unavailable", spec.name,
+                "compiled, but the backend reported no memory stats "
+                "(memory_analysis unavailable) — the footprint was NOT "
+                "checked against the budget",
+            ))
+        if budget.hbm_bytes is not None and peak is not None and peak > budget.hbm_bytes:
+            over = peak - budget.hbm_bytes
+            entry["hbm_over_budget_bytes"] = over
+            findings.append(_finding(
+                "hbm-over-budget", spec.name,
+                f"peak HBM {_mib(peak)} exceeds the {budget.source} budget "
+                f"{_mib(budget.hbm_bytes)} by {_mib(over)} "
+                f"(args {_mib(entry['argument_bytes'] or 0)}, temps "
+                f"{_mib(entry['temp_bytes'] or 0)}, outputs "
+                f"{_mib(entry['output_bytes'] or 0)}, generated code "
+                f"{_mib(entry['generated_code_bytes'] or 0)}, donation "
+                f"credit -{_mib(entry['alias_bytes'] or 0)})",
+            ))
+        tiles = getattr(unit, "pallas_tiles", None)
+        if tiles is not None:
+            vm = megakernel_vmem(tiles)
+            if vm is None:
+                entry["vmem_bytes"] = None
+                entry["vmem_note"] = (
+                    "shapes exceed the kernel tiling budget; runtime falls "
+                    "back to the exact GEMM stream"
+                )
+            else:
+                entry["vmem_bytes"] = vm["vmem_bytes"]
+                entry["vmem_tile_dims"] = vm["tile_dims"]
+                if (
+                    budget.vmem_bytes is not None
+                    and vm["vmem_bytes"] > budget.vmem_bytes
+                ):
+                    over = vm["vmem_bytes"] - budget.vmem_bytes
+                    entry["vmem_over_budget_bytes"] = over
+                    worst = max(vm["components"], key=vm["components"].get)
+                    findings.append(_finding(
+                        "vmem-over-budget", spec.name,
+                        f"megakernel tile set {_mib(vm['vmem_bytes'])} "
+                        f"exceeds the {budget.source} VMEM budget "
+                        f"{_mib(budget.vmem_bytes)} by {_mib(over)} "
+                        f"(largest tile: {worst} = "
+                        f"{_mib(vm['components'][worst])})",
+                    ))
+        table[spec.name] = entry
+    return table, findings
+
+
+def price_specs(
+    specs: Sequence,
+    budget: MemoryBudget,
+    *,
+    pool_rows: Optional[int] = None,
+    features: Optional[int] = None,
+    n_trees: Optional[int] = None,
+    max_depth: Optional[int] = None,
+) -> Tuple[Dict[str, Dict[str, Any]], List[Finding]]:
+    """:func:`memory_table` under a configured-shape override — the one
+    call every gating surface (``run.py --audit``, ``bench.py --audit``,
+    ``--memory``) shares, so the override/gate plumbing cannot drift
+    between them. All-None shapes price the registry's audit stand-ins."""
+    from distributed_active_learning_tpu.analysis import programs as programs_lib
+
+    with programs_lib.audit_shapes(
+        pool_rows=pool_rows, features=features,
+        n_trees=n_trees, max_depth=max_depth,
+    ):
+        return memory_table(specs, budget)
+
+
+def render_memory_table(
+    table: Dict[str, Dict[str, Any]], budget: MemoryBudget
+) -> str:
+    """Human table: one row per program, sorted by name, budgets in the
+    header so an over row is readable next to its ceiling."""
+    header = ("program", "peak_hbm", "args", "temps", "vmem", "verdict")
+    rows = []
+    for name in sorted(table):
+        e = table[name]
+        if "skipped" in e:
+            rows.append((name, "(skipped)", e["skipped"][:36], "", "", ""))
+            continue
+        if "error" in e:
+            rows.append((name, "(error)", e["error"][:36], "", "", "unpriced"))
+            continue
+
+        def _fmt(v):
+            return _mib(v) if isinstance(v, (int, float)) else "?"
+
+        verdict = "ok"
+        if "hbm_over_budget_bytes" in e:
+            verdict = f"HBM over by {_mib(e['hbm_over_budget_bytes'])}"
+        if "vmem_over_budget_bytes" in e:
+            sep = "; " if verdict != "ok" else ""
+            verdict = (
+                ("" if verdict == "ok" else verdict + sep)
+                + f"VMEM over by {_mib(e['vmem_over_budget_bytes'])}"
+            )
+        rows.append((
+            name,
+            _fmt(e.get("peak_hbm_bytes")),
+            _fmt(e.get("argument_bytes")),
+            _fmt(e.get("temp_bytes")),
+            _fmt(e["vmem_bytes"]) if e.get("vmem_bytes") is not None else "-",
+            verdict,
+        ))
+    widths = [
+        max(len(header[i]), *(len(str(r[i])) for r in rows)) if rows
+        else len(header[i])
+        for i in range(len(header))
+    ]
+
+    def _row(cols):
+        return "  ".join(str(c).ljust(w) for c, w in zip(cols, widths))
+
+    head = (
+        f"budget [{budget.source}]: hbm="
+        + (_mib(budget.hbm_bytes) if budget.hbm_bytes else "unlimited")
+        + ", vmem="
+        + (_mib(budget.vmem_bytes) if budget.vmem_bytes else "unlimited")
+    )
+    return "\n".join(
+        [head, _row(header), _row(["-" * w for w in widths])]
+        + [_row(r) for r in rows]
+    )
+
+
+def memory_section(
+    table: Dict[str, Dict[str, Any]],
+    findings: Sequence[Finding],
+    budget: MemoryBudget,
+) -> dict:
+    """The JSON-ready ``memory`` section the surfaces share (``--memory
+    --json``, the ``bench.py --audit`` payload, tier-1's asserts)."""
+    priced = [
+        e for e in table.values()
+        if "skipped" not in e and "error" not in e and "unpriced" not in e
+    ]
+    peaks = [
+        e["peak_hbm_bytes"] for e in priced
+        if e.get("peak_hbm_bytes") is not None
+    ]
+    counts = {"error": 0, "warn": 0, "info": 0}
+    for f in findings:
+        counts[f.severity] += 1
+    return {
+        "budget": budget.asdict(),
+        "programs_priced": len(priced),
+        "programs_skipped": len([e for e in table.values() if "skipped" in e]),
+        "programs_unpriced": len([
+            e for e in table.values() if "error" in e or "unpriced" in e
+        ]),
+        "max_peak_hbm_bytes": max(peaks) if peaks else None,
+        "counts": counts,
+        "findings": [f.asdict() for f in findings],
+        "programs": table,
+    }

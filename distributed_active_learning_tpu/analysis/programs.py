@@ -50,6 +50,7 @@ to array sizes, and tracing stays at seconds for the whole matrix.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import functools
 from typing import Callable, List, Optional, Sequence
@@ -73,8 +74,8 @@ LABEL_CAP = 40
 FIT_BUDGET = 48
 
 KINDS = (
-    "chunk", "fused_chunk", "sweep", "grid", "neural_sweep", "neural_chunk",
-    "serve", "serve_multi",
+    "chunk", "fused_chunk", "fused_select", "sweep", "grid", "neural_sweep",
+    "neural_chunk", "serve", "serve_multi",
 )
 GRID_D = 2   # datasets in the audited grid program
 GRID_E = 2   # seeds per (strategy, dataset)
@@ -89,6 +90,45 @@ SERVE_TENANTS = 2  # tenant axis of the audited serve_multi programs
 class SkipProgram(Exception):
     """Raised by a builder whose program cannot be constructed here (e.g. a
     mesh variant without enough devices); recorded as skipped, not clean."""
+
+
+@contextlib.contextmanager
+def audit_shapes(
+    pool_rows: Optional[int] = None,
+    features: Optional[int] = None,
+    n_trees: Optional[int] = None,
+    max_depth: Optional[int] = None,
+):
+    """Temporarily re-shape the registry builders to CONFIGURED dims
+    (pool rows rounded up to a mesh-divisible multiple of 8, tree count to
+    a model-axis-divisible even number).
+
+    The builders read the module shape constants at build() time, so specs
+    built inside this context trace/compile at the overridden scale — the
+    memory planner uses it to price the ACTUAL program a ``run.py --audit``
+    launch would allocate (compiling is shape-independent work: no data
+    materializes, a 10M-row program costs the same seconds to price as the
+    64-row stand-in). The feature width matters as much as the row count —
+    the dominant ``[n, d]`` pool buffer scales with BOTH — so callers that
+    know the dataset width must pass it. Rule audits should stay at the
+    tiny default shapes — structure is size-invariant and tracing stays
+    fast. Not thread-safe by construction (module-global override); the
+    audit is a pre-flight CLI step, not library surface.
+    """
+    global POOL_ROWS, FEATURES, N_TREES, MAX_DEPTH
+    saved = (POOL_ROWS, FEATURES, N_TREES, MAX_DEPTH)
+    try:
+        if pool_rows is not None:
+            POOL_ROWS = max(8, -(-int(pool_rows) // 8) * 8)
+        if features is not None:
+            FEATURES = max(1, int(features))
+        if n_trees is not None:
+            N_TREES = max(2, -(-int(n_trees) // 2) * 2)
+        if max_depth is not None:
+            MAX_DEPTH = max(1, int(max_depth))
+        yield
+    finally:
+        POOL_ROWS, FEATURES, N_TREES, MAX_DEPTH = saved
 
 
 @dataclasses.dataclass(frozen=True)
@@ -116,7 +156,11 @@ def _key_sds(shape=()):
     return jax.eval_shape(lambda: jax.random.split(jax.random.key(0), shape[0]))
 
 
-def _abstract_state(n=POOL_ROWS, d=FEATURES):
+def _abstract_state(n=None, d=None):
+    # n/d resolve at CALL time (POOL_ROWS/FEATURES defaults would bake the
+    # import-time values and defeat the audit_shapes override)
+    n = POOL_ROWS if n is None else n
+    d = FEATURES if d is None else d
     from distributed_active_learning_tpu.runtime import state as state_lib
 
     return state_lib.PoolState(
@@ -182,6 +226,23 @@ def _device_fit(kernel: str, quantize: str = "none"):
     )
 
 
+def _pallas_tiles(
+    quantize: str = "none", mesh_shape=None, window: int = WINDOW
+) -> dict:
+    """The megakernel tile parameters of a pallas-wrapped program at audit
+    shapes — what the memory planner's VMEM estimator prices. Mesh programs
+    run the kernel per shard: rows are the data-axis block, not the pool."""
+    rows = POOL_ROWS if mesh_shape is None else POOL_ROWS // mesh_shape[0]
+    return {
+        "n_trees": N_TREES,
+        "max_depth": MAX_DEPTH,
+        "n_rows": rows,
+        "features": FEATURES,
+        "window": window,
+        "quantize": quantize,
+    }
+
+
 def _strategy_and_aux(name: str):
     from distributed_active_learning_tpu.config import StrategyConfig
     from distributed_active_learning_tpu.strategies import StrategyAux, get_strategy
@@ -228,6 +289,8 @@ def _build_chunk(
         with_metrics=True,
         carry_in_argnums=(1,),
         carry_out_index=0,
+        pool_rows=POOL_ROWS,
+        pallas_tiles=_pallas_tiles(mesh_shape=mesh_shape) if mesh else None,
     )
 
 
@@ -275,7 +338,73 @@ def _build_fused_chunk(
         carry_in_argnums=(1,),
         carry_out_index=0,
         quantize=None if quantize == "none" else quantize,
+        pool_rows=POOL_ROWS,
+        pallas_tiles=(
+            _pallas_tiles(quantize=quantize, mesh_shape=mesh_shape)
+            if mesh else None
+        ),
     )
+
+
+def _build_fused_select(
+    name: str, placement: str, mesh_shape=MESH_SHAPE
+) -> AuditUnit:
+    """The STANDALONE round megakernel (ops/round_fused.fused_score_select):
+    eval -> score -> per-tile top-k outside the chunk scan — the exact
+    program whose VMEM tile set the memory planner prices, registered per
+    fused strategy plus the quantized-storage spellings. Single-device by
+    construction (on a TPU rig the same call takes the pallas megakernel;
+    the sharded fused path is audited through fused_chunk's mesh variant),
+    so the pallas tile claim rides the cpu placement."""
+    from distributed_active_learning_tpu.ops import round_fused
+
+    if placement != "cpu":
+        raise SkipProgram(
+            "the standalone fused selection is single-device (its sharded "
+            "spelling is fused_chunk's mesh variant); no mesh placement"
+        )
+    strategy_name, _, quantize = name.partition("-")
+    quantize = quantize or "none"
+    forest = jax.eval_shape(
+        _device_fit("gemm", quantize),
+        _sds((POOL_ROWS, FEATURES), jnp.int32),
+        _abstract_state(),
+        _key_sds(),
+    )
+
+    @jax.jit
+    def select(f, x, mask):
+        return round_fused.fused_score_select(
+            f, x, mask, strategy_name, WINDOW
+        )
+
+    args = (
+        forest,
+        _sds((POOL_ROWS, FEATURES), jnp.float32),
+        _sds((POOL_ROWS,), jnp.bool_),
+    )
+    return AuditUnit(
+        name=f"fused_select/{name}/{placement}",
+        fn=select,
+        args=args,
+        expect_donation=False,
+        pool_rows=POOL_ROWS,
+        # quantize is NOT set: the narrow-storage invariant needs the
+        # fit+eval pair in one trace (the fused_chunk variants audit it);
+        # here the quantized spellings exist for the VMEM/footprint pricing
+        # of the narrow operand layouts.
+        pallas_tiles=_pallas_tiles(quantize=quantize),
+    )
+
+
+def fused_select_names() -> List[str]:
+    """The standalone megakernel axis: every fused strategy plus the
+    quantized-storage spellings of one (same convention as fused_chunk)."""
+    from distributed_active_learning_tpu.ops.round_fused import FUSED_STRATEGIES
+
+    return sorted(FUSED_STRATEGIES) + [
+        "uncertainty-bf16", "uncertainty-int8",
+    ]
 
 
 def fused_chunk_names() -> List[str]:
@@ -335,6 +464,8 @@ def _build_sweep(
         with_metrics=True,
         carry_in_argnums=(3,),
         carry_out_index=0,
+        pool_rows=POOL_ROWS,
+        pallas_tiles=_pallas_tiles(mesh_shape=mesh_shape) if mesh else None,
     )
 
 
@@ -410,6 +541,8 @@ def _build_grid(
         with_metrics=True,
         carry_in_argnums=(3,),
         carry_out_index=0,
+        pool_rows=POOL_ROWS,
+        pallas_tiles=_pallas_tiles(mesh_shape=mesh_shape) if mesh else None,
     )
 
 
@@ -745,6 +878,10 @@ def _build_serve_multi(
             with_metrics=True,
             carry_in_argnums=(3,),
             carry_out_index=0,
+            pool_rows=POOL_ROWS,
+            pallas_tiles=(
+                _pallas_tiles(mesh_shape=mesh_shape) if mesh else None
+            ),
         )
     raise ValueError(f"unknown serve_multi program {program!r}")
 
@@ -797,6 +934,9 @@ def build_registry(
         # the round megakernel: every strategy it serves + the quantized
         # storage variants (the quantized-leaf-upcast rule's audit surface)
         ("fused_chunk", _build_fused_chunk, fused_chunk_names()),
+        # the STANDALONE megakernel selection (eval -> score -> top-k in one
+        # call, outside the chunk scan): the memory planner's VMEM subject
+        ("fused_select", _build_fused_select, fused_select_names()),
         ("sweep", _build_sweep, forest_strategy_names()),
         # one fixed heterogeneous group set: the grid program's novelty is
         # the multi-strategy merge itself, not per-strategy variants (each
@@ -817,7 +957,7 @@ def build_registry(
         # mesh-only filter doesn't smuggle cpu programs back into the audit
         kind_placements = (
             (("cpu",) if "cpu" in placements else ())
-            if kind in ("neural_sweep", "neural_chunk", "serve")
+            if kind in ("neural_sweep", "neural_chunk", "serve", "fused_select")
             else placements
         )
         for name in names:

@@ -61,6 +61,11 @@ class Report:
     findings: List[Finding] = dataclasses.field(default_factory=list)
     programs: List[str] = dataclasses.field(default_factory=list)
     skipped: Dict[str, str] = dataclasses.field(default_factory=dict)
+    #: Per-program accounting the rules computed on the way to their
+    #: verdicts (today: collective_bytes / collective_sites for programs
+    #: with any collective traffic) — numbers, not judgments, so a reviewer
+    #: can see HOW FAR under the gate a program sits.
+    stats: Dict[str, dict] = dataclasses.field(default_factory=dict)
 
     def extend(self, findings: Sequence[Finding]) -> None:
         self.findings.extend(findings)
@@ -93,6 +98,7 @@ class Report:
             "counts": self.counts(),
             "max_severity": self.max_severity,
             "findings": [f.asdict() for f in self.findings],
+            "program_stats": dict(self.stats),
         }
         return json.dumps(payload, indent=indent)
 

@@ -66,6 +66,50 @@ PEAK_HBM_BYTES_PER_SEC = {
     "TPU v6e": 1640e9,
 }
 
+_GiB = float(1 << 30)
+_MiB = float(1 << 20)
+
+#: Per-device HBM CAPACITY in bytes (public spec sheets; per jax device —
+#: one TensorCore on v2/v3, one megacore chip from v4 on). The static memory
+#: planner (analysis/memory.py) gates every registered program's peak
+#: footprint against these, so an r05-style OOM death becomes a named
+#: pre-flight finding instead of rc 124 with no artifact. The "cpu" entry is
+#: the CI/smoke stand-in: host RAM is not the scarce resource there, so the
+#: budget is a generous fixed slab that only a genuinely runaway program
+#: (or a deliberately tiny test table) can exceed.
+HBM_BYTES_PER_DEVICE = {
+    "TPU v2": 8 * _GiB,
+    "TPU v3": 16 * _GiB,
+    "TPU v4": 32 * _GiB,
+    "TPU v5 lite": 16 * _GiB,
+    "TPU v5e": 16 * _GiB,
+    "TPU v5": 95 * _GiB,
+    "TPU v5p": 95 * _GiB,
+    "TPU v6 lite": 32 * _GiB,
+    "TPU v6e": 32 * _GiB,
+    "cpu": 4 * _GiB,
+}
+
+#: Per-core VMEM capacity in bytes (~16 MiB on every shipped TPU core; see
+#: the pallas guide's memory hierarchy table). The planner's megakernel
+#: VMEM estimator prices the kernel's resident tile set against this. The
+#: "cpu" entry keeps the SAME 16 MiB: CPU runs never touch VMEM, but the
+#: megakernel's tile shapes are placement-independent, so pricing them
+#: against the TPU budget on the CPU rig catches an over-tiled kernel
+#: BEFORE the multi-hour TPU launch — exactly the pre-flight point.
+VMEM_BYTES_PER_CORE = {
+    "TPU v2": 16 * _MiB,
+    "TPU v3": 16 * _MiB,
+    "TPU v4": 16 * _MiB,
+    "TPU v5 lite": 16 * _MiB,
+    "TPU v5e": 16 * _MiB,
+    "TPU v5": 16 * _MiB,
+    "TPU v5p": 16 * _MiB,
+    "TPU v6 lite": 16 * _MiB,
+    "TPU v6e": 16 * _MiB,
+    "cpu": 16 * _MiB,
+}
+
 
 def _lookup(table: Dict[str, float], kind: str) -> Optional[float]:
     for name, peak in table.items():
@@ -91,6 +135,26 @@ def peak_bandwidth(kind: Optional[str] = None) -> Tuple[Optional[float], str]:
     """(HBM peak bytes/s, device_kind), None off the table like peak_flops."""
     kind = device_kind() if kind is None else kind
     return _lookup(PEAK_HBM_BYTES_PER_SEC, kind), kind
+
+
+def hbm_capacity(kind: Optional[str] = None) -> Tuple[Optional[float], str]:
+    """(HBM capacity bytes, device_kind) for this chip — the static memory
+    planner's per-device budget. Unknown accelerators return None (the
+    planner then reports footprints without gating them)."""
+    kind = device_kind() if kind is None else kind
+    cap = _lookup(HBM_BYTES_PER_DEVICE, kind)
+    if cap is None and kind.lower().startswith("cpu"):
+        cap = HBM_BYTES_PER_DEVICE["cpu"]
+    return cap, kind
+
+
+def vmem_capacity(kind: Optional[str] = None) -> Tuple[Optional[float], str]:
+    """(VMEM capacity bytes, device_kind), keyed like :func:`hbm_capacity`."""
+    kind = device_kind() if kind is None else kind
+    cap = _lookup(VMEM_BYTES_PER_CORE, kind)
+    if cap is None and kind.lower().startswith("cpu"):
+        cap = VMEM_BYTES_PER_CORE["cpu"]
+    return cap, kind
 
 
 # ---------------------------------------------------------------------------
@@ -123,6 +187,19 @@ def compiled_cost(compiled) -> Dict[str, Optional[float]]:
     return out
 
 
+def _cost_from_compiled(compiled) -> Dict[str, Optional[float]]:
+    """``{flops, bytes_accessed, flops_per_byte}`` from an already-compiled
+    executable — the one derivation :func:`program_cost` and
+    :func:`cost_table` share (the table also reads memory stats off the
+    same executable, so it must not pay a second compile)."""
+    cost = compiled_cost(compiled)
+    flops, nbytes = cost["flops"], cost["bytes_accessed"]
+    cost["flops_per_byte"] = (
+        round(flops / nbytes, 4) if flops and nbytes else None
+    )
+    return cost
+
+
 def program_cost(fn, *args) -> Dict[str, Optional[float]]:
     """Static cost of one jitted program at these (abstract or concrete)
     argument shapes: ``{flops, bytes_accessed, flops_per_byte}``.
@@ -132,29 +209,33 @@ def program_cost(fn, *args) -> Dict[str, Optional[float]]:
     that fail to lower/compile; :func:`cost_table` converts that into a
     per-program error entry instead.
     """
-    cost = compiled_cost(fn.lower(*args).compile())
-    flops, nbytes = cost["flops"], cost["bytes_accessed"]
-    cost["flops_per_byte"] = (
-        round(flops / nbytes, 4) if flops and nbytes else None
-    )
-    return cost
+    return _cost_from_compiled(fn.lower(*args).compile())
 
 
 def cost_table(specs) -> Dict[str, Dict[str, Any]]:
     """Price every registry program (analysis/programs.py ProgramSpecs).
 
-    Returns ``{program name: {flops, bytes_accessed, flops_per_byte}}``;
-    builders that decline (SkipProgram: mesh variants without devices) get
+    Returns ``{program name: {flops, bytes_accessed, flops_per_byte,
+    peak_hbm_bytes}}`` — the memory planner's peak footprint rides the SAME
+    compiled executable the cost model reads, so one ``--costs`` invocation
+    prices flops, bytes, and footprint per program without a second compile.
+    Builders that decline (SkipProgram: mesh variants without devices) get
     ``{"skipped": reason}`` and build/compile failures ``{"error": ...}`` —
     the table never silently drops a registered program.
     """
+    from distributed_active_learning_tpu.analysis import memory as memory_lib
     from distributed_active_learning_tpu.analysis.programs import SkipProgram
 
     table: Dict[str, Dict[str, Any]] = {}
     for spec in specs:
         try:
             unit = spec.build()
-            table[spec.name] = program_cost(unit.fn, *unit.args)
+            compiled = unit.fn.lower(*unit.args).compile()
+            cost = _cost_from_compiled(compiled)
+            cost["peak_hbm_bytes"] = memory_lib.compiled_memory(compiled)[
+                "peak_hbm_bytes"
+            ]
+            table[spec.name] = cost
         except SkipProgram as skip:
             table[spec.name] = {"skipped": str(skip)}
         except Exception as e:  # noqa: BLE001 — per-program, keep pricing
@@ -257,26 +338,29 @@ def attribute(
 
 def render_cost_table(table: Dict[str, Dict[str, Any]]) -> str:
     """Human table for ``--costs``: one row per program, sorted by name."""
-    header = ("program", "flops", "bytes", "flops/byte")
+    header = ("program", "flops", "bytes", "flops/byte", "peak_hbm")
     rows = []
     for name in sorted(table):
         entry = table[name]
         if "skipped" in entry:
-            rows.append((name, "(skipped)", entry["skipped"][:40], ""))
+            rows.append((name, "(skipped)", entry["skipped"][:40], "", ""))
             continue
         if "error" in entry:
-            rows.append((name, "(error)", entry["error"][:40], ""))
+            rows.append((name, "(error)", entry["error"][:40], "", ""))
             continue
 
         def _fmt(v):
             return f"{v:,.0f}" if isinstance(v, (int, float)) else "?"
 
+        peak = entry.get("peak_hbm_bytes")
         rows.append(
             (
                 name,
                 _fmt(entry.get("flops")),
                 _fmt(entry.get("bytes_accessed")),
                 str(entry.get("flops_per_byte") or "?"),
+                f"{peak / (1 << 20):.2f} MiB"
+                if isinstance(peak, (int, float)) else "?",
             )
         )
     widths = [

@@ -55,11 +55,15 @@ _64BIT_DTYPES = frozenset({"float64", "complex128", "int64", "uint64"})
 @dataclasses.dataclass(frozen=True)
 class EqnSite:
     """One equation plus where it sits: the primitive path from the program
-    root (e.g. ``scan/pjit/scan``) and whether a shard_map encloses it."""
+    root (e.g. ``scan/pjit/scan``), whether a shard_map encloses it, and the
+    product of enclosing scan trip counts (an eqn inside a length-3 round
+    scan EXECUTES three times per launch — byte accounting that ignores the
+    multiplier undercounts collective traffic by the round count)."""
 
     eqn: object
     path: Tuple[str, ...]
     in_shard_map: bool
+    trip_multiplier: int = 1
 
     @property
     def location(self) -> str:
@@ -96,17 +100,26 @@ def _sub_jaxprs(eqn) -> List[core.Jaxpr]:
 
 def iter_eqns(jaxpr: core.Jaxpr) -> Iterator[EqnSite]:
     """Depth-first walk over every equation, including those inside scan /
-    cond / pjit / shard_map / custom_* sub-jaxprs."""
+    cond / pjit / shard_map / custom_* sub-jaxprs. ``trip_multiplier``
+    accumulates scan ``length`` params down the walk (cond branches and
+    while bodies count as 1 — a static walk cannot bound them tighter)."""
 
-    def walk(jx: core.Jaxpr, path: Tuple[str, ...], in_sm: bool):
+    def walk(jx: core.Jaxpr, path: Tuple[str, ...], in_sm: bool, trips: int):
         for eqn in jx.eqns:
             name = eqn.primitive.name
-            yield EqnSite(eqn=eqn, path=path, in_shard_map=in_sm)
+            yield EqnSite(
+                eqn=eqn, path=path, in_shard_map=in_sm, trip_multiplier=trips
+            )
             inner_sm = in_sm or name == "shard_map"
+            inner_trips = trips
+            if name == "scan":
+                length = eqn.params.get("length")
+                if isinstance(length, int) and length > 0:
+                    inner_trips = trips * length
             for sub in _sub_jaxprs(eqn):
-                yield from walk(sub, path + (name,), inner_sm)
+                yield from walk(sub, path + (name,), inner_sm, inner_trips)
 
-    yield from walk(jaxpr, (), False)
+    yield from walk(jaxpr, (), False, 1)
 
 
 def iter_avals(jaxpr: core.Jaxpr) -> Iterator[Tuple[str, object]]:
@@ -334,6 +347,159 @@ def _rule_shard_map_collectives(unit) -> Iterator[Finding]:
                 f"{name} inside a shard_map region rematerializes the "
                 "sharded axis on every shard",
             )
+
+
+# ---------------------------------------------------------------------------
+# sharding / collective invariants (the pod-sharding contract)
+# ---------------------------------------------------------------------------
+
+#: Primitives that move bytes across a mesh axis. The byte accounting prices
+#: every one of them (per-shard operand bytes x scan trips); the pool-scale
+#: rule only fires on those whose operands carry a pool-sized dim.
+COLLECTIVE_PRIMITIVES = frozenset({
+    "psum", "pmin", "pmax", "ppermute", "pbroadcast", "all_gather",
+    "all_to_all", "reduce_scatter", "psum_scatter", "pgather",
+})
+
+
+def _aval_nbytes(aval) -> Optional[float]:
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if shape is None or dtype is None:
+        return None
+    n = 1.0
+    for s in shape:
+        if not isinstance(s, int):
+            return None  # dynamic dims: unpriceable statically
+        n *= s
+    try:
+        return n * dtype.itemsize
+    except Exception:
+        return None
+
+
+def _has_pool_dim(aval, pool_rows: int) -> bool:
+    shape = getattr(aval, "shape", ())
+    return any(isinstance(s, int) and s >= pool_rows for s in shape)
+
+
+def collective_traffic(unit) -> List[Tuple[object, float]]:
+    """Every collective site inside a shard_map region with its per-launch
+    byte cost: per-shard operand bytes x the enclosing scan trip count.
+    The per-SHARD number is deliberate — it is what crosses each link."""
+    out = []
+    for site in unit.eqn_sites:
+        if not site.in_shard_map:
+            continue
+        if site.eqn.primitive.name not in COLLECTIVE_PRIMITIVES:
+            continue
+        nbytes = 0.0
+        for v in site.eqn.invars:
+            b = _aval_nbytes(getattr(v, "aval", None))
+            if b:
+                nbytes += b
+        out.append((site, nbytes * site.trip_multiplier))
+    return out
+
+
+#: Derived collective budget: this many times the largest input operand.
+#: Sanctioned traffic (vote psums, ring ppermutes, bookkeeping reductions)
+#: sits orders of magnitude below it; an all-gathered pool axis (shards x
+#: pool bytes x rounds) blows straight through.
+COLLECTIVE_BUDGET_FACTOR = 16
+
+
+@register_rule(
+    "replicated-pool-operand",
+    "error",
+    "a pool-sized operand must not enter a shard_map fully replicated "
+    "(empty in_names): every device then holds — and streams — the whole "
+    "pool, which is exactly the footprint pod-sharding exists to remove",
+)
+def _rule_replicated_pool(unit) -> Iterator[Finding]:
+    pool_rows = getattr(unit, "pool_rows", None)
+    if not pool_rows:
+        return
+    for site in unit.eqn_sites:
+        if site.eqn.primitive.name != "shard_map":
+            continue
+        in_names = site.eqn.params.get("in_names", ())
+        for v, names in zip(site.eqn.invars, in_names):
+            aval = getattr(v, "aval", None)
+            if aval is None or not _has_pool_dim(aval, pool_rows):
+                continue
+            if not names:  # {} = no dim sharded over any mesh axis
+                yield _finding(
+                    "replicated-pool-operand", unit, site.location,
+                    f"pool-sized operand {_aval_str(aval)} enters shard_map "
+                    "fully replicated (empty in_names) — every shard "
+                    "materializes the whole pool",
+                )
+
+
+@register_rule(
+    "pool-scale-collective",
+    "error",
+    "no collective may move a pool-sized array across the mesh (a "
+    "per-shard operand carrying a pool-scale dim means the sharding "
+    "failed to divide the pool before the collective ran)",
+)
+def _rule_pool_scale_collective(unit) -> Iterator[Finding]:
+    pool_rows = getattr(unit, "pool_rows", None)
+    if not pool_rows:
+        return
+    for site in unit.eqn_sites:
+        if not site.in_shard_map:
+            continue
+        name = site.eqn.primitive.name
+        if name not in COLLECTIVE_PRIMITIVES:
+            continue
+        for v in site.eqn.invars:
+            aval = getattr(v, "aval", None)
+            if aval is not None and _has_pool_dim(aval, pool_rows):
+                yield _finding(
+                    "pool-scale-collective", unit, site.location,
+                    f"{name} moves a pool-scale operand {_aval_str(aval)} "
+                    "across the mesh — per-shard traffic proportional to "
+                    "the FULL pool, not the shard",
+                )
+                break
+
+
+@register_rule(
+    "collective-bytes-over-budget",
+    "error",
+    "a program's accounted collective traffic (per-shard operand bytes x "
+    "scan trips, summed over every collective in its shard_map regions) "
+    "must stay under its budget — default 16x the largest input operand; "
+    "the exactness contract the ring-exchange selection merge inherits",
+)
+def _rule_collective_bytes(unit) -> Iterator[Finding]:
+    traffic = collective_traffic(unit)
+    if not traffic:
+        return
+    total = sum(b for _, b in traffic)
+    budget = getattr(unit, "collective_bytes_budget", None)
+    if budget is None:
+        largest = max(
+            (b for b in (
+                _aval_nbytes(a) for a in unit.jaxpr.in_avals
+            ) if b),
+            default=None,
+        )
+        if largest is None:
+            return
+        budget = COLLECTIVE_BUDGET_FACTOR * largest
+    if total <= budget:
+        return
+    worst_site, worst_bytes = max(traffic, key=lambda t: t[1])
+    yield _finding(
+        "collective-bytes-over-budget", unit, worst_site.location,
+        f"collective traffic {total:,.0f} B/launch exceeds the budget "
+        f"{budget:,.0f} B ({len(traffic)} collective site(s); worst: "
+        f"{worst_site.eqn.primitive.name} at {worst_bytes:,.0f} B incl. "
+        f"x{worst_site.trip_multiplier} scan trips)",
+    )
 
 
 @register_rule(

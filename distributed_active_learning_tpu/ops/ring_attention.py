@@ -53,7 +53,9 @@ def _ring_attention_sharded(
     scale: float,
 ) -> jnp.ndarray:
     """Per-shard kernel. q/k/v: [B, T_blk, H, D] (local block)."""
-    n_dev = lax.axis_size(axis_name)
+    # jax 0.4.x has no lax.axis_size; psum of 1 over the axis is the
+    # portable spelling (a trace-time constant, not a runtime collective)
+    n_dev = lax.psum(1, axis_name)
     my = lax.axis_index(axis_name)
     B, T, H, D = q.shape
 

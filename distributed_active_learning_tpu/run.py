@@ -585,8 +585,57 @@ def _audit_or_die(
                 kinds=sorted({s.kind for s in specs}),
                 placements=["cpu"],
             )
-        report = run_audit(cpu_specs)
+        specs = cpu_specs
+        report = run_audit(specs)
     report.extend(lint_paths(default_lint_targets()))
+    # The static memory planner: compile the SAME programs this run would
+    # launch and gate their peak HBM / megakernel VMEM against the chip's
+    # budget (analysis/roofline.py capacity tables; DAL_MEMORY_BUDGET names
+    # a JSON override — {"hbm_bytes": N, "vmem_bytes": N} — the test route
+    # and the operator escape hatch). Pricing happens at the CONFIGURED
+    # pool scale when it is statically known (--n-samples): compiling is
+    # shape-independent work, so the 10M-row program the run would actually
+    # allocate is what gets priced — not the registry's 64-row stand-in,
+    # which no real budget could ever refuse. An over-budget program
+    # REFUSES the launch with the overage named, so an OOM death on the
+    # rig becomes a pre-flight finding instead of rc 124 with no artifact.
+    import os
+
+    from distributed_active_learning_tpu.analysis import memory as memory_lib
+    from distributed_active_learning_tpu.analysis import programs as programs_lib
+
+    budget_path = os.environ.get("DAL_MEMORY_BUDGET")
+    budget = (
+        memory_lib.load_budget_table(budget_path)
+        if budget_path
+        else memory_lib.device_budget()
+    )
+    pool_rows = getattr(getattr(cfg, "data", None), "n_samples", None)
+    forest_cfg = getattr(cfg, "forest", None)
+    if not pool_rows:
+        print(
+            "# audit: pool scale unknown before data load; memory gate "
+            f"priced at the {programs_lib.POOL_ROWS}-row audit shapes "
+            "(pass --n-samples to price the configured scale)",
+            file=sys.stderr,
+        )
+    else:
+        # feature width is a data property the pre-flight cannot see; the
+        # n x d pool buffer is therefore priced at the audit width — say so
+        # rather than letting the gate read as exact
+        print(
+            f"# audit: memory gate priced at {pool_rows} pool rows, "
+            f"{programs_lib.FEATURES}-feature audit width (dataset width "
+            "is unknown before data load)",
+            file=sys.stderr,
+        )
+    _mem_table, mem_findings = memory_lib.price_specs(
+        specs, budget,
+        pool_rows=pool_rows or None,
+        n_trees=getattr(forest_cfg, "n_trees", None),
+        max_depth=getattr(forest_cfg, "max_depth", None),
+    )
+    report.extend(mem_findings)
     if report.findings:
         print(report.render_table(), file=sys.stderr)
     if report.gate("error"):

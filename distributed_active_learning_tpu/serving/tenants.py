@@ -1141,35 +1141,48 @@ class TenantManager:
 
     def _batched_score_reason(self) -> Optional[str]:
         """None when the cross-tenant fused path may serve; a named fallback
-        reason otherwise (recorded in the summary, never silent)."""
-        if self._batched_reason_cache is not None:
-            return self._batched_reason_cache[0]
-        reason = None
-        tenants = list(self._tenants.values())
-        if len(tenants) < 2:
-            reason = "single_tenant"
-        elif len({t._forest_sig for t in tenants}) > 1:
-            reason = "forest_structure"
-        elif any(t.cfg.forest.kernel not in _BATCHABLE_KERNELS for t in tenants):
-            reason = "kernel"
-        elif len({t.serve.score_width for t in tenants}) > 1:
-            reason = "score_width"
-        elif len({int(t._slab.x.shape[1]) for t in tenants}) > 1:
-            reason = "feature_width"
-        self._batched_reason_cache = (reason,)
-        return reason
+        reason otherwise (recorded in the summary, never silent). The cache
+        fill runs on the dispatcher thread while ``add_tenant`` invalidates
+        under the manager lock from a client thread — same lock here, or a
+        stale reason serves the wrong path (flagged by DAL201)."""
+        with self._lock:
+            if self._batched_reason_cache is not None:
+                return self._batched_reason_cache[0]
+            reason = None
+            tenants = list(self._tenants.values())
+            if len(tenants) < 2:
+                reason = "single_tenant"
+            elif len({t._forest_sig for t in tenants}) > 1:
+                reason = "forest_structure"
+            elif any(
+                t.cfg.forest.kernel not in _BATCHABLE_KERNELS for t in tenants
+            ):
+                reason = "kernel"
+            elif len({t.serve.score_width for t in tenants}) > 1:
+                reason = "score_width"
+            elif len({int(t._slab.x.shape[1]) for t in tenants}) > 1:
+                reason = "feature_width"
+            self._batched_reason_cache = (reason,)
+            return reason
 
     def _mark_forest_dirty(self) -> None:
-        self._stacked_dirty = True
+        with self._lock:
+            self._stacked_dirty = True
 
     def _stacked(self):
-        if self._stacked_dirty or self._stacked_forest is None:
-            forests = [t._forest for t in self._tenants.values()]
-            self._stacked_forest = jax.tree_util.tree_map(
-                lambda *ls: jnp.stack(ls), *forests
-            )
-            self._stacked_dirty = False
-        return self._stacked_forest
+        # The re-stack must be ATOMIC with the dirty flag (a touchdown
+        # marking dirty mid-stack would be lost); the stack itself is a
+        # dispatch under the manager lock, which is the accepted cost here —
+        # one dispatcher thread by design, and RLock re-entry keeps the
+        # score path cheap when the cache is warm.
+        with self._lock:
+            if self._stacked_dirty or self._stacked_forest is None:
+                forests = [t._forest for t in self._tenants.values()]
+                self._stacked_forest = jax.tree_util.tree_map(  # audit: ok[DAL202]
+                    lambda *ls: jnp.stack(ls), *forests
+                )
+                self._stacked_dirty = False
+            return self._stacked_forest
 
     def score_many(self, requests: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
         """Serve concurrent score requests from several tenants as fused
@@ -1674,7 +1687,8 @@ class TenantManager:
                     )
             finally:
                 with self._lock:
-                    for k, v in list(self._pending.items()):
+                    # a snapshot for safe in-loop deletion, not a jit key
+                    for k, v in list(self._pending.items()):  # audit: ok[DAL104]
                         if v is job:
                             del self._pending[k]
                 job.done.set()

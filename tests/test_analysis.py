@@ -644,6 +644,427 @@ def test_quantized_fused_chunk_audits_clean():
     assert report.findings == [], [str(f) for f in report.findings]
 
 
+# ---------------------------------------------------------------------------
+# PR 13: sharding/collective invariants (seeded violations + accounting)
+# ---------------------------------------------------------------------------
+
+
+def _mesh_and_P(devices):
+    from jax.sharding import PartitionSpec as P
+
+    from distributed_active_learning_tpu.parallel import make_mesh
+
+    return make_mesh(data=4, model=2), P
+
+
+def test_replicated_pool_operand_rule_fires_and_respects_sharded(devices):
+    """A pool-sized operand entering shard_map with empty in_names (fully
+    replicated) must fire; the same operand sharded over the data axis is
+    the sanctioned layout and must not."""
+    from distributed_active_learning_tpu.utils.compat import shard_map
+
+    mesh, P = _mesh_and_P(devices)
+
+    @jax.jit
+    def planted(x, w):
+        def body(xb, wb):
+            return (xb * wb[:1]).sum()
+
+        return shard_map(
+            body, mesh=mesh, in_specs=(P("data"), P(None)),
+            out_specs=P(None), check_vma=False,
+        )(x, w)
+
+    unit = AuditUnit(
+        name="fixture/replicated-pool",
+        fn=planted,
+        args=(_sds((64,), jnp.float32), _sds((64,), jnp.float32)),
+        pool_rows=64,
+    )
+    assert "replicated-pool-operand" in _rules_fired(audit_unit(unit))
+
+    @jax.jit
+    def sharded(x, w):
+        def body(xb, wb):
+            return (xb * wb).sum()
+
+        return shard_map(
+            body, mesh=mesh, in_specs=(P("data"), P("data")),
+            out_specs=P(None), check_vma=False,
+        )(x, w)
+
+    ok = AuditUnit(
+        name="fixture/sharded-pool",
+        fn=sharded,
+        args=(_sds((64,), jnp.float32), _sds((64,), jnp.float32)),
+        pool_rows=64,
+    )
+    assert "replicated-pool-operand" not in _rules_fired(audit_unit(ok))
+    # without a pool threshold the rule is disarmed (single-device programs)
+    off = AuditUnit(
+        name="fixture/no-threshold",
+        fn=planted,
+        args=(_sds((64,), jnp.float32), _sds((64,), jnp.float32)),
+    )
+    assert "replicated-pool-operand" not in _rules_fired(audit_unit(off))
+
+
+def test_pool_scale_collective_rule_fires_on_planted_gather(devices):
+    """An all_gather that rematerializes a pool-scale axis inside shard_map
+    must fire BOTH the PR-6 collective rule and the new pool-scale rule;
+    shard-width psums stay clean."""
+    from distributed_active_learning_tpu.utils.compat import shard_map
+
+    mesh, P = _mesh_and_P(devices)
+
+    @jax.jit
+    def planted(x):
+        def body(xb):
+            full = jax.lax.all_gather(xb, "data", axis=0, tiled=True)
+            return jax.lax.psum(full, "model")[:2]
+
+        return shard_map(
+            body, mesh=mesh, in_specs=P("data"), out_specs=P(None),
+            check_vma=False,
+        )(x)
+
+    unit = AuditUnit(
+        name="fixture/pool-gather", fn=planted,
+        args=(_sds((64,), jnp.float32),), pool_rows=64,
+    )
+    fired = _rules_fired(audit_unit(unit))
+    assert "pool-scale-collective" in fired
+    assert "collective-in-shard-map" in fired
+
+    @jax.jit
+    def ok_psum(x):
+        def body(xb):
+            return jax.lax.psum(xb, "model")  # [16] block: shard width
+
+        return shard_map(
+            body, mesh=mesh, in_specs=P("data"), out_specs=P("data"),
+            check_vma=False,
+        )(x)
+
+    ok = AuditUnit(
+        name="fixture/shard-psum", fn=ok_psum,
+        args=(_sds((64,), jnp.float32),), pool_rows=64,
+    )
+    assert "pool-scale-collective" not in _rules_fired(audit_unit(ok))
+
+
+def test_collective_bytes_accounting_and_budget_gate(devices):
+    """Collective traffic is accounted per launch WITH scan trip counts
+    multiplied in, surfaces through the stats sink, and gates against the
+    unit's budget."""
+    from distributed_active_learning_tpu.utils.compat import shard_map
+
+    mesh, P = _mesh_and_P(devices)
+
+    @jax.jit
+    def planted(x):
+        def body(xb):
+            def step(c, _):
+                return c + jax.lax.psum(xb, "data").sum(), None
+
+            out, _ = jax.lax.scan(step, 0.0, None, length=10)
+            return out
+
+        return shard_map(
+            body, mesh=mesh, in_specs=P("data"), out_specs=P(None),
+            check_vma=False,
+        )(x)
+
+    args = (_sds((64,), jnp.float32),)
+    stats = {}
+    findings = audit_unit(
+        AuditUnit(name="fixture/coll-bytes", fn=planted, args=args),
+        stats=stats,
+    )
+    # [16] f32 block = 64 B per psum, x10 scan trips
+    assert stats["collective_bytes"] == 640.0
+    assert stats["collective_sites"] == 1
+    assert "collective-bytes-over-budget" not in _rules_fired(findings)
+
+    over = audit_unit(
+        AuditUnit(
+            name="fixture/coll-over", fn=planted, args=args,
+            collective_bytes_budget=100.0,
+        )
+    )
+    assert "collective-bytes-over-budget" in _rules_fired(over)
+    [finding] = [f for f in over if f.rule == "collective-bytes-over-budget"]
+    assert "640" in finding.message and "x10" in finding.message
+
+
+def test_collective_bytes_ride_report_stats(devices):
+    """run_audit carries the accounting into Report.stats / the JSON
+    payload (program_stats) for mesh programs with collective traffic."""
+    import json
+
+    report = run_audit(
+        build_registry(
+            strategies=["uncertainty"], kinds=["fused_chunk"],
+            placements=["mesh4x2"],
+        )
+    )
+    assert report.findings == [], [str(f) for f in report.findings]
+    stats = report.stats.get("fused_chunk/uncertainty/mesh4x2")
+    assert stats and stats["collective_bytes"] > 0
+    payload = json.loads(report.to_json())
+    assert "fused_chunk/uncertainty/mesh4x2" in payload["program_stats"]
+
+
+# ---------------------------------------------------------------------------
+# PR 13: DAL2xx host-concurrency lint (seeded violations + waivers + scope)
+# ---------------------------------------------------------------------------
+
+_CONCURRENCY_FIXTURE = """
+import threading
+import jax.numpy as jnp
+
+class Manager:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self._programs = {}
+
+    def locked_bump(self):
+        with self._lock:
+            self.count += 1
+
+    def racy_bump(self):
+        self.count += 1
+
+    def dispatch_under_lock(self, x):
+        with self._lock:
+            return jnp.sum(x)
+
+    def racy_install(self, key, value):
+        with self._lock:
+            if key in self._programs:
+                return False
+        with self._lock:
+            self._programs[key] = value
+
+    def atomic_install(self, key, value):
+        with self._lock:
+            if key in self._programs:
+                return False
+            self._programs[key] = value
+
+    def start(self):
+        t = threading.Thread(target=self.racy_bump, daemon=True)
+        t.start()
+"""
+
+
+def _lint_concurrency_fixture(tmp_path, source, relpath="serving/fixture.py"):
+    p = tmp_path / "fixture_conc.py"
+    p.write_text(source)
+    return lint_lib.lint_file(str(p), relpath)
+
+
+def test_dal201_guarded_attr_mutated_outside_lock(tmp_path):
+    findings = _lint_concurrency_fixture(tmp_path, _CONCURRENCY_FIXTURE)
+    dal201 = [f for f in findings if f.rule == "DAL201"]
+    assert len(dal201) == 1 and "racy" not in dal201[0].location
+    assert "self.count" in dal201[0].message
+    # the waiver silences exactly this rule at exactly this site
+    waived = _lint_concurrency_fixture(
+        tmp_path,
+        _CONCURRENCY_FIXTURE.replace(
+            "    def racy_bump(self):\n        self.count += 1",
+            "    def racy_bump(self):\n"
+            "        self.count += 1  # audit: ok[DAL201]",
+        ),
+    )
+    assert "DAL201" not in _rules_fired(waived)
+
+
+def test_dal201_catches_tuple_assignment_mutation(tmp_path):
+    """`self.a, self.b = ...` mutates both attrs — the unpacking spelling
+    must not slip past the race rule."""
+    src = (
+        "import threading\n"
+        "class M:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def locked(self):\n"
+        "        with self._lock:\n"
+        "            self.a = 1\n"
+        "    def racy(self, f):\n"
+        "        self.a, self.b = f, False\n"
+    )
+    findings = _lint_concurrency_fixture(tmp_path, src)
+    dal201 = [f for f in findings if f.rule == "DAL201"]
+    assert len(dal201) == 1 and "self.a" in dal201[0].message
+
+
+def test_dal202_skips_callbacks_defined_under_lock(tmp_path):
+    """A nested def/lambda merely DEFINED under the lock runs later, after
+    release — it must not fire DAL202 (the direct dispatch still does)."""
+    src = (
+        "import threading\n"
+        "import jax\n"
+        "class M:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.x = 0\n"
+        "    def touch(self):\n"
+        "        with self._lock:\n"
+        "            self.x = 1\n"
+        "    def deferred(self, x):\n"
+        "        with self._lock:\n"
+        "            def cb():\n"
+        "                return jax.device_put(x)\n"
+        "            self._cb = cb\n"
+    )
+    assert "DAL202" not in _rules_fired(
+        _lint_concurrency_fixture(tmp_path, src)
+    )
+
+
+def test_dal202_dispatch_under_lock(tmp_path):
+    findings = _lint_concurrency_fixture(tmp_path, _CONCURRENCY_FIXTURE)
+    dal202 = [f for f in findings if f.rule == "DAL202"]
+    assert len(dal202) == 1 and "jnp.sum" in dal202[0].message
+    waived = _lint_concurrency_fixture(
+        tmp_path,
+        _CONCURRENCY_FIXTURE.replace(
+            "return jnp.sum(x)", "return jnp.sum(x)  # audit: ok[DAL202]"
+        ),
+    )
+    assert "DAL202" not in _rules_fired(waived)
+
+
+def test_dal203_non_atomic_install_vs_atomic(tmp_path):
+    """The check-then-act race fires; the single-lock-block install — the
+    AOT precompile worker's correct pattern — stays clean."""
+    findings = _lint_concurrency_fixture(tmp_path, _CONCURRENCY_FIXTURE)
+    dal203 = [f for f in findings if f.rule == "DAL203"]
+    assert len(dal203) == 1
+    assert "_programs" in dal203[0].message
+    waived = _lint_concurrency_fixture(
+        tmp_path,
+        _CONCURRENCY_FIXTURE.replace(
+            "            self._programs[key] = value\n\n    def atomic",
+            "            self._programs[key] = value  # audit: ok[DAL203]\n"
+            "\n    def atomic",
+        ),
+    )
+    assert "DAL203" not in _rules_fired(waived)
+
+
+def test_dal204_thread_without_discipline(tmp_path):
+    findings = _lint_concurrency_fixture(tmp_path, _CONCURRENCY_FIXTURE)
+    assert "DAL204" in _rules_fired(findings)
+    # a module that joins its thread (or registers atexit) is disciplined
+    joined = _CONCURRENCY_FIXTURE + (
+        "\n    def stop(self):\n        self._thread.join()\n"
+    )
+    assert "DAL204" not in _rules_fired(
+        _lint_concurrency_fixture(tmp_path, joined)
+    )
+    waived = _lint_concurrency_fixture(
+        tmp_path,
+        _CONCURRENCY_FIXTURE.replace(
+            "t = threading.Thread(target=self.racy_bump, daemon=True)",
+            "t = threading.Thread(  # audit: ok[DAL204]\n"
+            "            target=self.racy_bump, daemon=True)",
+        ),
+    )
+    assert "DAL204" not in _rules_fired(waived)
+
+
+def test_dal204_not_silenced_by_string_join(tmp_path):
+    """A module-wide `"\\n".join(lines)` must NOT count as thread-join
+    discipline — only a join on a thread-ish receiver (the name a
+    threading.Thread was assigned to, or a thread/worker-named variable)
+    disarms the rule."""
+    undisciplined = _CONCURRENCY_FIXTURE + (
+        "\ndef render(lines):\n"
+        "    return '\\n'.join(lines)\n"
+    )
+    assert "DAL204" in _rules_fired(
+        _lint_concurrency_fixture(tmp_path, undisciplined)
+    )
+    # joining the variable the Thread was assigned to counts
+    disciplined = _CONCURRENCY_FIXTURE.replace(
+        "        t.start()", "        t.start()\n        t.join()"
+    )
+    assert "DAL204" not in _rules_fired(
+        _lint_concurrency_fixture(tmp_path, disciplined)
+    )
+
+
+def test_dal2xx_scope_from_file_path_components(tmp_path):
+    """A serving/ file linted under a bare basename relpath (lint_file with
+    no rel, or a single-dir lint_paths whose commonpath lands inside
+    serving/) must still get the concurrency pass — the scope reads the
+    file's own path, not just the caller's relpath spelling."""
+    d = tmp_path / "serving"
+    d.mkdir()
+    p = d / "mod.py"
+    p.write_text(_CONCURRENCY_FIXTURE)
+    fired = _rules_fired(lint_lib.lint_file(str(p)))  # relpath = basename
+    assert any(r.startswith("DAL2") for r in fired)
+    fired = _rules_fired(lint_lib.lint_paths([str(p)]))
+    assert any(r.startswith("DAL2") for r in fired)
+
+
+def test_dal2xx_scoped_to_threaded_surfaces(tmp_path):
+    """The concurrency rules apply under serving/ and runtime/ only — the
+    same source linted as a strategies/ file yields no DAL2xx findings
+    (the DAL1xx recompile hazards still run everywhere)."""
+    for scope, expect in (
+        ("serving/m.py", True),
+        ("runtime/m.py", True),
+        ("strategies/m.py", False),
+    ):
+        fired = _rules_fired(
+            _lint_concurrency_fixture(tmp_path, _CONCURRENCY_FIXTURE, scope)
+        )
+        assert any(r.startswith("DAL2") for r in fired) == expect, scope
+
+
+def test_default_lint_targets_cover_serving():
+    targets = [t.replace("\\\\", "/") for t in lint_lib.default_lint_targets()]
+    assert any("/serving/" in t for t in targets)
+    assert any("/runtime/" in t for t in targets)
+
+
+def test_registry_covers_fused_select_kind():
+    """The standalone megakernel selection audits per fused strategy plus
+    the quantized spellings (cpu; its sharded spelling is fused_chunk's
+    mesh variant), and carries the VMEM tile claim the memory planner
+    prices."""
+    from distributed_active_learning_tpu.ops.round_fused import FUSED_STRATEGIES
+
+    specs = build_registry(kinds=["fused_select"])
+    names = {s.name for s in specs}
+    for strat in FUSED_STRATEGIES:
+        assert f"fused_select/{strat}/cpu" in names
+    for variant in ("uncertainty-bf16", "uncertainty-int8"):
+        assert f"fused_select/{variant}/cpu" in names
+    unit = next(
+        s for s in specs if s.name == "fused_select/uncertainty/cpu"
+    ).build()
+    assert unit.pallas_tiles is not None
+    assert unit.pool_rows == 64
+
+
+def test_fused_select_program_audits_clean():
+    report = run_audit(
+        build_registry(
+            strategies=["uncertainty"], kinds=["fused_select"],
+            placements=["cpu"],
+        )
+    )
+    assert report.programs == ["fused_select/uncertainty/cpu"]
+    assert report.findings == [], [str(f) for f in report.findings]
+
+
 def test_specs_for_experiment_fused_round_routes_to_fused_chunk():
     """A --fused-round run must audit the megakernel chunk it will launch,
     including the quantized-storage spelling."""

@@ -1,0 +1,411 @@
+"""Static memory planner (analysis/memory.py): every rule proven live.
+
+Mirrors the test_analysis.py contract: each finding the planner can emit
+(``hbm-over-budget``, ``vmem-over-budget``, ``memory-plan-unavailable``) is
+exercised by a seeded violation — an over-budget program against a tiny fake
+budget table, a pallas tile claim against a starved VMEM budget, a broken
+builder — and the real surfaces (registry programs, ``run.py --audit``,
+``bench.py --audit``) are checked clean/refusing as appropriate.
+"""
+
+import functools
+import json
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distributed_active_learning_tpu.analysis import memory as memory_lib
+from distributed_active_learning_tpu.analysis import roofline
+from distributed_active_learning_tpu.analysis.auditor import AuditUnit
+from distributed_active_learning_tpu.analysis.programs import (
+    ProgramSpec,
+    SkipProgram,
+)
+
+
+def _sds(shape, dtype):
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _spec(name, build):
+    return ProgramSpec(
+        name=name, kind="fixture", strategy="fixture", placement="cpu",
+        build=build,
+    )
+
+
+def _small_unit(**kwargs):
+    @jax.jit
+    def f(x):
+        return x @ x.T
+
+    return AuditUnit(
+        name=kwargs.pop("name", "fixture/small"),
+        fn=f, args=(_sds((64, 64), jnp.float32),), **kwargs,
+    )
+
+
+TINY = memory_lib.MemoryBudget(hbm_bytes=1024.0, vmem_bytes=2048.0, source="tiny")
+ROOMY = memory_lib.MemoryBudget(hbm_bytes=1 << 32, vmem_bytes=1 << 24, source="roomy")
+
+
+# ---------------------------------------------------------------------------
+# budget tables
+# ---------------------------------------------------------------------------
+
+
+def test_device_budget_for_cpu_and_tpu_kinds():
+    cpu = memory_lib.device_budget("cpu")
+    assert cpu.hbm_bytes == roofline.HBM_BYTES_PER_DEVICE["cpu"]
+    assert cpu.vmem_bytes == roofline.VMEM_BYTES_PER_CORE["cpu"]
+    v4 = memory_lib.device_budget("TPU v4")
+    assert v4.hbm_bytes == 32 * (1 << 30)
+    unknown = memory_lib.device_budget("Weird Accelerator 9000")
+    assert unknown.hbm_bytes is None  # unpriced, not zero
+
+
+def test_load_budget_table_roundtrip_and_validation(tmp_path):
+    p = tmp_path / "budget.json"
+    p.write_text(json.dumps({"hbm_bytes": 123.0, "vmem_bytes": None}))
+    b = memory_lib.load_budget_table(str(p))
+    assert b.hbm_bytes == 123.0 and b.vmem_bytes is None
+    assert b.source == str(p)
+    p.write_text(json.dumps({"hbm_bytes": -1}))
+    with pytest.raises(ValueError, match="positive"):
+        memory_lib.load_budget_table(str(p))
+    p.write_text(json.dumps({"hbm_gib": 1}))
+    with pytest.raises(ValueError, match="unknown budget keys"):
+        memory_lib.load_budget_table(str(p))
+
+
+# ---------------------------------------------------------------------------
+# peak-HBM normalization
+# ---------------------------------------------------------------------------
+
+
+def test_compiled_memory_normalizes_real_stats():
+    @jax.jit
+    def f(x):
+        return x @ x.T
+
+    mem = memory_lib.program_memory(f, _sds((64, 64), jnp.float32))
+    assert mem["argument_bytes"] == 64 * 64 * 4
+    assert mem["output_bytes"] == 64 * 64 * 4
+    assert mem["peak_hbm_bytes"] is not None and mem["peak_hbm_bytes"] > 0
+
+
+def test_compiled_memory_applies_donation_credit():
+    """A donated carry's aliased output bytes must NOT double-count: the
+    donated spelling's peak is smaller than the copy spelling's by the
+    aliased buffer."""
+
+    def body(state, x):
+        return state + x, x.sum()
+
+    donated = jax.jit(body, donate_argnums=(0,))
+    plain = jax.jit(body)
+    args = (_sds((1024,), jnp.float32), _sds((1024,), jnp.float32))
+    with_credit = memory_lib.program_memory(donated, *args)
+    without = memory_lib.program_memory(plain, *args)
+    assert with_credit["alias_bytes"] == 1024 * 4
+    assert (
+        with_credit["peak_hbm_bytes"]
+        == without["peak_hbm_bytes"] - 1024 * 4
+    )
+
+
+def test_compiled_memory_handles_unreportable_backend():
+    class Broken:
+        def memory_analysis(self):
+            raise NotImplementedError
+
+    mem = memory_lib.compiled_memory(Broken())
+    assert mem["peak_hbm_bytes"] is None  # None, never 0
+
+
+# ---------------------------------------------------------------------------
+# VMEM estimator
+# ---------------------------------------------------------------------------
+
+
+def test_megakernel_vmem_prices_audit_and_rig_shapes():
+    small = memory_lib.megakernel_vmem(
+        dict(n_trees=8, max_depth=3, n_rows=64, features=4, window=5,
+             quantize="none")
+    )
+    assert small is not None
+    assert small["tile_dims"]["bn"] == 512
+    assert small["vmem_bytes"] == sum(small["components"].values())
+    # rig-scale shapes still fit the 16 MiB core budget
+    rig = memory_lib.megakernel_vmem(
+        dict(n_trees=128, max_depth=8, n_rows=1_000_000, features=512,
+             window=100, quantize="int8")
+    )
+    assert rig is not None
+    assert rig["vmem_bytes"] < roofline.VMEM_BYTES_PER_CORE["cpu"]
+    # quantized storage narrows the streamed forest tiles
+    wide = memory_lib.megakernel_vmem(
+        dict(n_trees=128, max_depth=8, n_rows=1_000_000, features=512,
+             window=100, quantize="none")
+    )
+    assert wide["vmem_bytes"] > rig["vmem_bytes"]
+
+
+def test_megakernel_vmem_none_past_tiling_budget():
+    """Shapes tile_dims declines (depth > 8) fall back to the exact GEMM
+    stream at runtime — no VMEM claim to price, spelled None not 0."""
+    assert memory_lib.megakernel_vmem(
+        dict(n_trees=8, max_depth=9, n_rows=64, features=4, window=5,
+             quantize="none")
+    ) is None
+
+
+# ---------------------------------------------------------------------------
+# the planner gate: seeded violations
+# ---------------------------------------------------------------------------
+
+
+def _rules(findings):
+    return {f.rule for f in findings}
+
+
+def test_hbm_over_budget_fires_with_overage_named():
+    table, findings = memory_lib.memory_table(
+        [_spec("fixture/over", _small_unit)], TINY
+    )
+    assert _rules(findings) == {"hbm-over-budget"}
+    [f] = findings
+    assert f.severity == "error" and "exceeds the tiny budget" in f.message
+    assert "hbm_over_budget_bytes" in table["fixture/over"]
+
+
+def test_vmem_over_budget_fires_on_pallas_tiled_program():
+    build = functools.partial(
+        _small_unit,
+        name="fixture/tiled",
+        pallas_tiles=dict(
+            n_trees=8, max_depth=3, n_rows=64, features=4, window=5,
+            quantize="none",
+        ),
+    )
+    starved = memory_lib.MemoryBudget(
+        hbm_bytes=1 << 32, vmem_bytes=2048.0, source="starved"
+    )
+    table, findings = memory_lib.memory_table([_spec("fixture/tiled", build)], starved)
+    assert _rules(findings) == {"vmem-over-budget"}
+    [f] = findings
+    assert "largest tile" in f.message
+    assert table["fixture/tiled"]["vmem_bytes"] > 2048
+
+
+def test_clean_program_passes_and_prices():
+    table, findings = memory_lib.memory_table(
+        [_spec("fixture/clean", _small_unit)], ROOMY
+    )
+    assert findings == []
+    entry = table["fixture/clean"]
+    assert entry["peak_hbm_bytes"] > 0 and "hbm_over_budget_bytes" not in entry
+
+
+def test_skipped_and_broken_builders_never_vanish():
+    def skipper():
+        raise SkipProgram("no devices here")
+
+    def broken():
+        raise RuntimeError("builder bug")
+
+    table, findings = memory_lib.memory_table(
+        [_spec("fixture/skip", skipper), _spec("fixture/broken", broken)],
+        ROOMY,
+    )
+    assert table["fixture/skip"] == {"skipped": "no devices here"}
+    assert "error" in table["fixture/broken"]
+    assert _rules(findings) == {"memory-plan-unavailable"}
+    assert all(f.severity == "warn" for f in findings)  # unpriced != over
+
+
+def test_backend_without_memory_stats_never_reads_as_priced(monkeypatch):
+    """A program the backend compiles but cannot report stats for must
+    surface as a warn finding and an unpriced entry — a gate that checked
+    nothing must never read as clean (the silent-green path)."""
+    monkeypatch.setattr(
+        memory_lib, "program_memory",
+        lambda fn, *args: memory_lib.compiled_memory(object()),
+    )
+    table, findings = memory_lib.memory_table(
+        [_spec("fixture/statless", _small_unit)], ROOMY
+    )
+    assert _rules(findings) == {"memory-plan-unavailable"}
+    assert table["fixture/statless"]["unpriced"] is True
+    section = memory_lib.memory_section(table, findings, ROOMY)
+    assert section["programs_priced"] == 0
+    assert section["programs_unpriced"] == 1
+
+
+def test_memory_section_shape_and_render():
+    specs = [_spec("fixture/clean", _small_unit)]
+    table, findings = memory_lib.memory_table(specs, TINY)
+    section = memory_lib.memory_section(table, findings, TINY)
+    assert section["programs_priced"] == 1
+    assert section["counts"]["error"] == 1
+    assert section["budget"]["source"] == "tiny"
+    assert section["max_peak_hbm_bytes"] == table["fixture/clean"]["peak_hbm_bytes"]
+    rendered = memory_lib.render_memory_table(table, TINY)
+    assert "HBM over by" in rendered and "budget [tiny]" in rendered
+
+
+# ---------------------------------------------------------------------------
+# real surfaces: registry program clean, --costs column, CLI, run.py refusal
+# ---------------------------------------------------------------------------
+
+
+def test_registry_fused_select_prices_clean_with_vmem():
+    """The standalone megakernel program — the planner's primary subject —
+    prices under the CPU budget with its VMEM estimate present."""
+    from distributed_active_learning_tpu.analysis.programs import build_registry
+
+    specs = build_registry(
+        strategies=["uncertainty"], kinds=["fused_select"], placements=["cpu"]
+    )
+    table, findings = memory_lib.memory_table(specs, memory_lib.device_budget("cpu"))
+    assert findings == [], [str(f) for f in findings]
+    entry = table["fused_select/uncertainty/cpu"]
+    assert entry["peak_hbm_bytes"] > 0
+    assert entry["vmem_bytes"] > 0 and "vmem_tile_dims" in entry
+
+
+def test_cost_table_carries_peak_hbm_column():
+    """One --costs invocation prices flops, bytes, AND footprint (same
+    compiled executable, no second compile)."""
+    from distributed_active_learning_tpu.analysis.programs import build_registry
+
+    specs = build_registry(
+        strategies=["random"], kinds=["chunk"], placements=["cpu"]
+    )
+    table = roofline.cost_table(specs)
+    entry = table["chunk/random/cpu"]
+    assert entry["flops"] is not None
+    assert entry["peak_hbm_bytes"] is not None and entry["peak_hbm_bytes"] > 0
+    assert "peak_hbm" in roofline.render_cost_table(table)
+
+
+def test_cli_memory_json_and_gate(tmp_path, capsys):
+    from distributed_active_learning_tpu.analysis.__main__ import main
+
+    rc = main([
+        "--memory", "--json", "--kinds", "chunk", "--strategies", "random",
+        "--placements", "cpu",
+    ])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    mem = payload["memory"]
+    assert mem["programs_priced"] == 1
+    assert "chunk/random/cpu" in mem["programs"]
+    # a tiny budget table flips the same invocation to a refusal (exit 1)
+    p = tmp_path / "tiny.json"
+    p.write_text(json.dumps({"hbm_bytes": 64, "source": "tiny-ci"}))
+    rc = main([
+        "--memory", "--json", "--kinds", "chunk", "--strategies", "random",
+        "--placements", "cpu", "--budget-table", str(p),
+    ])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert payload["memory"]["counts"]["error"] >= 1
+    assert payload["memory"]["findings"][0]["rule"] == "hbm-over-budget"
+
+
+def test_run_audit_refuses_over_budget_launch(tmp_path, monkeypatch, capsys):
+    """run.py --audit must refuse to launch a config whose program exceeds
+    the budget, naming the overage — the acceptance contract."""
+    from distributed_active_learning_tpu import run as run_mod
+
+    p = tmp_path / "tiny.json"
+    p.write_text(json.dumps({"hbm_bytes": 64, "source": "tiny-ci"}))
+    monkeypatch.setenv("DAL_MEMORY_BUDGET", str(p))
+    with pytest.raises(SystemExit) as exc:
+        run_mod.main(["--audit", "--rounds", "2", "--n-samples", "200"])
+    assert "audit failed" in str(exc.value)
+    err = capsys.readouterr().err
+    assert "hbm-over-budget" in err and "exceeds the tiny-ci budget" in err
+
+
+def test_audit_shapes_reprices_the_configured_pool_scale():
+    """The audit_shapes override makes the registry builders trace/compile
+    at the CONFIGURED pool scale — the 4M-row program prices at hundreds of
+    MiB (exact compiled stats, linear in rows), not the 64-row stand-in's
+    tens of KiB, so a real device budget can actually refuse a real
+    over-budget launch. The default shapes restore afterwards."""
+    from distributed_active_learning_tpu.analysis import programs as prog
+    from distributed_active_learning_tpu.analysis.programs import build_registry
+
+    budget = memory_lib.MemoryBudget(
+        hbm_bytes=50 * (1 << 20), vmem_bytes=None, source="mid"
+    )
+    with prog.audit_shapes(pool_rows=4_000_000):
+        specs = build_registry(
+            strategies=["uncertainty"], kinds=["chunk"], placements=["cpu"]
+        )
+        table, findings = memory_lib.memory_table(specs, budget)
+    assert prog.POOL_ROWS == 64  # restored
+    entry = table["chunk/uncertainty/cpu"]
+    # pool x [4M, 4] f32 alone is 64 MiB; the exact compiled peak must
+    # reflect the configured scale and blow the 50 MiB budget
+    assert entry["peak_hbm_bytes"] > 100 * (1 << 20)
+    assert entry["alias_bytes"] > 0  # donation credit survives at scale
+    assert _rules(findings) == {"hbm-over-budget"}
+
+
+def test_run_audit_refuses_configured_scale_over_device_class_budget(
+    tmp_path, monkeypatch, capsys
+):
+    """The acceptance contract end to end at a REALISTIC budget: a 4M-row
+    config is refused under a 50 MiB table while a 200-row config passes
+    the same table — the gate prices the configured scale, not the audit
+    stand-in (whose KiB footprint no real budget could refuse)."""
+    from distributed_active_learning_tpu import run as run_mod
+
+    p = tmp_path / "mid.json"
+    p.write_text(json.dumps({"hbm_bytes": 50 * (1 << 20), "source": "mid"}))
+    monkeypatch.setenv("DAL_MEMORY_BUDGET", str(p))
+    with pytest.raises(SystemExit) as exc:
+        run_mod.main(["--audit", "--rounds", "2", "--n-samples", "4000000"])
+    assert "audit failed" in str(exc.value)
+    assert "hbm-over-budget" in capsys.readouterr().err
+
+
+def test_bench_audit_gate_carries_memory_section(monkeypatch):
+    """bench.py --audit: the payload's audit summary carries the memory
+    section (presence is the tier-1/JSON-always contract; the full-matrix
+    gate lives in the analysis CI job)."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_for_memory_test",
+        os.path.join(os.path.dirname(__file__), os.pardir, "bench.py"),
+    )
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    from distributed_active_learning_tpu.analysis import programs as prog
+
+    full_registry = prog.build_registry
+
+    def tiny_registry(strategies=None, kinds=None, placements=None):
+        return full_registry(
+            strategies=["random"], kinds=["chunk"], placements=["cpu"]
+        )
+
+    # bench._audit_gate resolves build_registry from the analysis package
+    # namespace at call time; patch that binding
+    monkeypatch.setattr(
+        "distributed_active_learning_tpu.analysis.build_registry",
+        tiny_registry,
+    )
+    summary = bench._audit_gate()
+    assert summary["programs_audited"] >= 1
+    mem = summary["memory"]
+    assert mem["programs_priced"] >= 1
+    assert mem["counts"]["error"] == 0
+    assert "budget" in mem and mem["budget"]["hbm_bytes"] is not None

@@ -326,6 +326,34 @@ def test_compare_grid_metrics_in_vocabulary(compare_bench):
     assert report["hard_regressions"] == ["grid_recompiles_after_warmup"]
 
 
+def test_compare_programs_audited_shrink_fires_hard(compare_bench):
+    """A payload that audited FEWER programs than its baseline is a silent
+    registry shrink — any decrease fires HARD, lifted from the nested
+    ``audit`` section bench.py emits; growth and parity stay green."""
+    base = {"audit": {"programs_audited": 79}}
+    report = compare_bench.compare_payloads(
+        base, {"audit": {"programs_audited": 70}}
+    )
+    assert report["hard_regressions"] == ["programs_audited"]
+    assert report["verdict"] == "regression:programs_audited"
+
+    ok = compare_bench.compare_payloads(
+        base, {"audit": {"programs_audited": 79}}
+    )
+    assert ok["regressions"] == []
+    grown = compare_bench.compare_payloads(
+        base, {"audit": {"programs_audited": 85}}
+    )
+    assert grown["regressions"] == []
+    # payloads without an audit section (plain bench runs) skip visibly
+    bare = compare_bench.compare_payloads(base, {"value": 1.0})
+    assert any(
+        s["metric"] == "programs_audited" for s in bare["skipped"]
+    )
+    # the lift never mutates the caller's payloads
+    assert "programs_audited" not in base
+
+
 def test_compare_r03_r04_names_the_mfu_regression(compare_bench):
     base = compare_bench.load_payload(os.path.join(REPO, "BENCH_r03.json"))
     cur = compare_bench.load_payload(os.path.join(REPO, "BENCH_r04.json"))
