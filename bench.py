@@ -1084,6 +1084,50 @@ def bench_grid(args):
         # grid_recompiles_after_warmup)
         out["baseline_skipped"] = skip
         out["grid_baseline_skipped"] = skip
+
+    # Scenario-axis smoke leg (scenarios/): one pipelined launch over the
+    # scenario x strategy x seed table — the four-family engine riding the
+    # SAME grid stream. Entropy strategy (the knapsack needs nonnegative
+    # higher-is-better scores); the recompile twin is the contract that the
+    # scenario spelling keeps the one-compile-for-the-matrix property.
+    from distributed_active_learning_tpu.config import ScenarioConfig
+
+    scenario_axis = [
+        ScenarioConfig(),
+        ScenarioConfig(kind="noisy_oracle", flip_prob=0.1, abstain_prob=0.25),
+        ScenarioConfig(kind="cost_budget", cost_budget=2.5 * window),
+        ScenarioConfig(kind="rare_event", rare_class=1),
+        ScenarioConfig(kind="drift", drift_rate=0.2),
+    ]
+    scn_cfg = dataclasses.replace(
+        cfg, strategy=dataclasses.replace(cfg.strategy, name="entropy")
+    )
+    scn_cells = len(scenario_axis) * E
+    _flight("bench_timing_start", label="grid/scenario_axis", cells=scn_cells)
+    t0 = time.perf_counter()
+    scn_grid = run_grid(
+        scn_cfg, ["entropy"], seeds,
+        scenarios=scenario_axis,
+        bundles={"bench_grid": bundle},
+    )
+    scn_sec = time.perf_counter() - t0
+    _flight(
+        "bench_timing_end", label="grid/scenario_axis",
+        seconds=round(scn_sec, 3),
+    )
+    out.update({
+        "scenario_axis": [s.kind for s in scenario_axis],
+        "scenario_cells": scn_cells,
+        "scenario_seconds": round(scn_sec, 3),
+        "scenario_cells_rounds_per_second": round(
+            scn_cells * rounds / scn_sec, 2
+        ),
+        "scenario_launches": scn_grid.launches,
+        # hard-gated twin (compare_bench): the scenario grid must stay
+        # one-compile-for-the-matrix after its first launch, like the
+        # clean grid
+        "scenario_recompiles_after_warmup": scn_grid.recompiles_after_warmup,
+    })
     return out
 
 
@@ -1841,9 +1885,10 @@ def _run_mode(args) -> dict:
     # round includes the roofline pricing compiles (device_round, fit, chunk
     # through the AOT path) on top of the timing bodies.
     # round grew the PR-10 fused-vs-unfused legs (two extra chunk compiles
-    # + their timed reps) on top of the roofline pricing compiles.
+    # + their timed reps) on top of the roofline pricing compiles; grid grew
+    # the PR-14 scenario-axis leg (one more grid-chunk compile + its stream).
     _cpu_cost = {
-        "score": 30, "density": 25, "round": 340, "sweep": 90, "grid": 150,
+        "score": 30, "density": 25, "round": 340, "sweep": 90, "grid": 170,
         "serve": 120, "serve-multi": 180, "lal": 30, "neural": 260,
     }
 

@@ -73,6 +73,8 @@ DEFAULT_SPECS: List[MetricSpec] = [
     MetricSpec("sweep_speedup", "higher", 0.30),
     MetricSpec("grid_cells_rounds_per_second", "higher", 0.30),
     MetricSpec("grid_speedup", "higher", 0.30),
+    # scenario-axis grid smoke (scenarios/; bench.py bench_grid scenario leg)
+    MetricSpec("scenario_cells_rounds_per_second", "higher", 0.30),
     MetricSpec("serve_qps", "higher", 0.30),
     MetricSpec("serve_scores_per_sec", "higher", 0.30),
     MetricSpec("serve_p50_ms", "lower", 0.40),
@@ -103,6 +105,10 @@ DEFAULT_SPECS: List[MetricSpec] = [
     # serve-multi's namespaced twin, plus the AOT-precompile acceptance gate:
     # any post-warmup query paying a slab-growth compile is an architectural
     # regression (the p99 spike PR 12 killed), never noise
+    MetricSpec(
+        "scenario_recompiles_after_warmup", "lower", 0.0, kind="counter",
+        hard=True,
+    ),
     MetricSpec(
         "serve_multi_recompiles_after_warmup", "lower", 0.0, kind="counter",
         hard=True,

@@ -243,6 +243,75 @@ def summarize(events: List[dict]) -> str:
             )
         )
 
+    # Scenario tables (scenarios/): recall-at-budget from rare_event cells'
+    # in-scan RoundMetrics and per-round labeling spend from cost_budget
+    # cells. The metric keys only exist on cells whose scenario emits them
+    # (run_grid filters per cell), so presence IS the filter. Defensive like
+    # the serve-latency table: malformed events (missing / non-numeric /
+    # bool-typed values) are skipped, never a crash.
+    def _num(e, key):
+        v = e.get(key)
+        return (
+            v if isinstance(v, (int, float)) and not isinstance(v, bool)
+            else None
+        )
+
+    rare_rounds = [e for e in rounds if _num(e, "rare_recall") is not None]
+    if rare_rounds:
+        by_group: Dict[tuple, dict] = {}
+        for e in rare_rounds:
+            gkey = (str(e.get("strategy", "?")), str(e.get("dataset", "?")))
+            cell = by_group.setdefault(gkey, {})
+            cell.setdefault(e.get("seed", e.get("exp", 0)), []).append(e)
+        rows = []
+        for (strat, ds), cells in sorted(by_group.items()):
+            # the last round's recall per cell IS recall-at-budget (the
+            # curve's value at the stop; earlier rounds trace the curve)
+            finals = [evs[-1]["rare_recall"] for evs in cells.values()]
+            labeled = [
+                n for evs in cells.values()
+                if (n := _num(evs[-1], "n_labeled")) is not None
+            ]
+            mean = sum(finals) / len(finals)
+            rows.append([
+                strat, ds, len(cells),
+                f"{100 * mean:.1f}",
+                f"{100 * max(finals):.1f}",
+                int(max(labeled)) if labeled else "-",
+            ])
+        out.append(
+            "\n== recall-at-budget ==\n"
+            + _table(
+                ["strategy", "dataset", "cells", "recall@budget % (mean)",
+                 "best %", "labeled"],
+                rows,
+            )
+        )
+
+    cost_rounds = [e for e in rounds if _num(e, "cost_spent") is not None]
+    if cost_rounds:
+        by_group2: Dict[tuple, list] = {}
+        for e in cost_rounds:
+            gkey = (str(e.get("strategy", "?")), str(e.get("dataset", "?")))
+            by_group2.setdefault(gkey, []).append(e)
+        rows = []
+        for (strat, ds), evs in sorted(by_group2.items()):
+            spends = [e["cost_spent"] for e in evs]
+            rows.append([
+                strat, ds, len(spends),
+                f"{sum(spends) / len(spends):.2f}",
+                f"{max(spends):.2f}",
+                f"{sum(spends):.2f}",
+            ])
+        out.append(
+            "\n== cost spend ==\n"
+            + _table(
+                ["strategy", "dataset", "rounds", "mean spend/round",
+                 "max spend/round", "total spend"],
+                rows,
+            )
+        )
+
     # Per-phase totals — the reference's TIMESTAMP table. Phase times appear
     # on round events when the per-round driver ran; the scan-fused driver
     # attributes per program launch instead (next section).

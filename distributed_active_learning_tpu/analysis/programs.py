@@ -75,7 +75,7 @@ FIT_BUDGET = 48
 
 KINDS = (
     "chunk", "fused_chunk", "fused_select", "sweep", "grid", "neural_sweep",
-    "neural_chunk", "serve", "serve_multi",
+    "neural_chunk", "serve", "serve_multi", "scenario",
 )
 GRID_D = 2   # datasets in the audited grid program
 GRID_E = 2   # seeds per (strategy, dataset)
@@ -890,6 +890,102 @@ def serve_multi_program_names() -> List[str]:
     return ["batched_score", "chunk", "ingest"]
 
 
+def _scenario_audit_cfg(program: str):
+    """The representative ScenarioConfig each scenario audit program runs
+    under — nonzero probabilities/rates so every scenario branch actually
+    traces (a zero-rate scenario would reduce to the clean body and audit
+    nothing new)."""
+    from distributed_active_learning_tpu.config import ScenarioConfig
+
+    return {
+        "noisy_chunk": ScenarioConfig(
+            kind="noisy_oracle", flip_prob=0.25, abstain_prob=0.25
+        ),
+        "cost_chunk": ScenarioConfig(kind="cost_budget", cost_budget=8.0),
+        "drift_chunk": ScenarioConfig(kind="drift", drift_rate=0.1),
+        "rare_chunk": ScenarioConfig(kind="rare_event", rare_class=1),
+    }[program]
+
+
+def _build_scenario(program: str, placement: str) -> AuditUnit:
+    """The scenario engine's programs (scenarios/ + runtime/loop.py): the
+    scenario-round chunk per family — noisy reveal (probabilistic
+    ``reveal_masked`` fed by a third key split), knapsack selection
+    (``ops.topk.knapsack_top_k`` with the cost vector as a runtime input),
+    per-round drifted eval, and the in-scan rare-recall metric — plus the
+    standalone knapsack selection kernel. The chunks keep the clean chunk's
+    donation and carry-aval contracts (the same scan machinery), which is
+    exactly what the donation/carry rules pin here."""
+    if placement != "cpu":
+        raise SkipProgram(
+            "scenario rounds are single-device for now (the sharded "
+            "scenario round rides the pod-sharding ROADMAP item); no mesh "
+            "variant"
+        )
+    if program == "knapsack_select":
+        from distributed_active_learning_tpu.ops import topk
+
+        @jax.jit
+        def select(scores, costs, mask):
+            return topk.knapsack_top_k(scores, costs, mask, WINDOW, 8.0)
+
+        args = (
+            _sds((POOL_ROWS,), jnp.float32),
+            _sds((POOL_ROWS,), jnp.float32),
+            _sds((POOL_ROWS,), jnp.bool_),
+        )
+        return AuditUnit(
+            name=f"scenario/knapsack_select/{placement}",
+            fn=select,
+            args=args,
+            expect_donation=False,
+            pool_rows=POOL_ROWS,
+        )
+    from distributed_active_learning_tpu.runtime.loop import make_chunk_fn
+
+    scn = _scenario_audit_cfg(program)
+    # entropy for the knapsack chunk (nonnegative higher-is-better scores,
+    # the validated cost contract); uncertainty elsewhere, like `chunk`.
+    strategy_name = "entropy" if program == "cost_chunk" else "uncertainty"
+    strategy, aux = _strategy_and_aux(strategy_name)
+    chunk_fn = make_chunk_fn(
+        strategy, WINDOW, CHUNK_ROUNDS, _device_fit("gemm"), LABEL_CAP,
+        with_metrics=True,
+        n_classes=2,
+        scenario=scn,
+    )
+    costs = (
+        _sds((POOL_ROWS,), jnp.float32) if program == "cost_chunk" else None
+    )
+    args = (
+        _sds((POOL_ROWS, FEATURES), jnp.int32),     # codes
+        _abstract_state(),                           # state (donated carry)
+        aux,
+        _key_sds(),                                  # fit_key
+        _sds((TEST_ROWS, FEATURES), jnp.float32),    # test_x
+        _sds((TEST_ROWS,), jnp.int32),               # test_y
+        _sds((), jnp.int32),                         # end_round
+        costs,                                       # scenario cost vector
+    )
+    return AuditUnit(
+        name=f"scenario/{program}/{placement}",
+        fn=chunk_fn,
+        args=args,
+        expect_donation=True,
+        with_metrics=True,
+        carry_in_argnums=(1,),
+        carry_out_index=0,
+        pool_rows=POOL_ROWS,
+    )
+
+
+def scenario_program_names() -> List[str]:
+    return [
+        "cost_chunk", "drift_chunk", "knapsack_select", "noisy_chunk",
+        "rare_chunk",
+    ]
+
+
 # ---------------------------------------------------------------------------
 # the registry
 # ---------------------------------------------------------------------------
@@ -949,6 +1045,11 @@ def build_registry(
         # both placements (the grid machinery shards); batched_score/ingest
         # skip mesh with a named reason inside the builder
         ("serve_multi", _build_serve_multi, serve_multi_program_names()),
+        # the scenario engine's round variants (noisy reveal, knapsack
+        # select, drifted eval, rare metric) + the standalone knapsack
+        # kernel — the donation/carry invariants of the clean chunk must
+        # survive every scenario body
+        ("scenario", _build_scenario, scenario_program_names()),
     ):
         if kind not in kinds:
             continue
@@ -957,7 +1058,10 @@ def build_registry(
         # mesh-only filter doesn't smuggle cpu programs back into the audit
         kind_placements = (
             (("cpu",) if "cpu" in placements else ())
-            if kind in ("neural_sweep", "neural_chunk", "serve", "fused_select")
+            if kind in (
+                "neural_sweep", "neural_chunk", "serve", "fused_select",
+                "scenario",
+            )
             else placements
         )
         for name in names:
@@ -1031,6 +1135,23 @@ def specs_for_experiment(
                 ),
             )
         ]
+    scn = getattr(cfg, "scenario", None)
+    if scn is not None and getattr(scn, "kind", "none") != "none":
+        # A scenario run launches the scenario-round chunk — audit THAT
+        # program (donation/carry rules over the noisy/knapsack/drift/rare
+        # bodies), not the clean chunk the run will never trace. Single
+        # scenario runs only: the scenario GRID audits the grid program
+        # above (grid_strategies wins) — its scenario spelling is a named
+        # follow-up.
+        prog = {
+            "noisy_oracle": "noisy_chunk",
+            "cost_budget": "cost_chunk",
+            "drift": "drift_chunk",
+            "rare_event": "rare_chunk",
+        }[scn.kind]
+        return build_registry(
+            strategies=[prog], kinds=["scenario"], placements=["cpu"]
+        )
     kind = "sweep" if getattr(cfg, "sweep_seeds", 1) > 1 else "chunk"
     name = cfg.strategy.name
     if kind == "chunk" and getattr(cfg, "fused_round", False):

@@ -109,6 +109,63 @@ class DataConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class ScenarioConfig:
+    """Scenario-engine configuration (scenarios/): perturb the AL loop
+    without forking it.
+
+    ``kind`` selects the scenario family; every family rides the SAME
+    drivers (``runtime.loop``/``runtime.sweep``) as config + grid axes
+    rather than new loops, and ``kind="none"`` (the default) leaves every
+    traced program byte-identical to the pre-scenario code — the engine is
+    only wired in when a scenario is active.
+
+    - ``"none"``          — the clean pool-based loop (the default).
+    - ``"noisy_oracle"``  — the oracle flips each point's label with
+      ``flip_prob`` (drawn once per experiment from the scenario seed, so
+      repeated queries are consistent) and ABSTAINS on each reveal with
+      ``abstain_prob``: abstained picks stay unlabeled and re-enter the
+      pool, so budget accounting counts REVEALED labels, never picks (an
+      all-abstain oracle never terminates a cell early — ``max_rounds`` is
+      therefore required when ``abstain_prob > 0``).
+    - ``"cost_budget"``   — per-point labeling costs (synthesized from the
+      scenario seed, in ``[1, 1 + cost_spread]``) with budget-constrained
+      selection: a greedy knapsack top-k by score-per-cost under a
+      per-round spend cap ``cost_budget`` (ops/topk.py
+      ``knapsack_top_k``). Nonnegative higher-is-better scores only.
+    - ``"rare_event"``    — class-imbalanced hunting: the reported metric
+      is recall-at-budget of ``rare_class`` (fraction of the pool's rare
+      points labeled so far), computed in-scan and riding
+      ``RoundMetrics.rare_recall``.
+    - ``"drift"``         — the evaluation stream drifts over rounds: the
+      test set is transformed per round index (``drift_kind``
+      "mean_shift" or "rotation" at ``drift_rate`` per round,
+      data/synthetic.py schedules) before the in-scan accuracy pass — the
+      pool is historical data, the incoming traffic moves.
+    """
+
+    kind: str = "none"
+    # noisy_oracle
+    flip_prob: float = 0.0
+    abstain_prob: float = 0.0
+    # cost_budget
+    cost_budget: float = 0.0   # per-round spend cap (> 0 required)
+    cost_spread: float = 4.0   # synthetic costs in [1, 1 + cost_spread]
+    # rare_event
+    rare_class: int = 1
+    # drift
+    drift_kind: str = "mean_shift"  # or "rotation"
+    drift_rate: float = 0.0         # per-round drift magnitude
+    # Scenario randomness (flip masks, cost vectors, drift direction) is
+    # keyed separately from the experiment seed so a scenario=none cell's
+    # PRNG stream is untouched.
+    seed: int = 0
+
+    @property
+    def active(self) -> bool:
+        return self.kind != "none"
+
+
+@dataclasses.dataclass(frozen=True)
 class MeshConfig:
     """Device-mesh layout for the sharded AL round.
 
@@ -169,6 +226,27 @@ class ServeConfig:
     # per tenant before new submissions are refused with AdmissionError —
     # the backpressure signal concurrent clients actually observe.
     max_pending: int = 64
+    # Per-tenant SLO class (serving/frontend.py): ``slo_weight`` is the
+    # tenant's share of contended dispatch cycles under deficit weighted
+    # round-robin — 1.0 (the default) serves the tenant every cycle exactly
+    # like the pre-SLO fair rotation; 0.5 every other contended cycle.
+    # ``slo_priority`` scales admission under load: a priority-p tenant's
+    # effective queue cap is ``max_pending * (1 + p)``, so lower classes
+    # shed load (AdmissionError) first.
+    slo_weight: float = 1.0
+    slo_priority: int = 0
+    # Drift-aware bin-edge refresh (serving/tenants.py): the binning is
+    # frozen at cold start; when the EMA fraction of ingested feature
+    # values landing OUTSIDE the cold-start quantile edges exceeds
+    # ``bin_refresh_out_frac`` (with at least ``drift_min_fresh`` fresh
+    # points), the service re-quantiles the edges from the current slab,
+    # re-codes the pool, rebuilds its fit/chunk programs against the new
+    # edges, and bumps the forest fingerprint. In-distribution streams sit
+    # near 2/max_bins out-of-range by construction, far under a typical
+    # threshold of 0.35. <= 0 (the default) disables — the refresh is
+    # opt-in, so services configured before it keep the frozen-edges
+    # behavior and its jit-cache/latency profile byte-for-byte.
+    bin_refresh_out_frac: float = 0.0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -179,6 +257,11 @@ class ExperimentConfig:
     forest: ForestConfig = dataclasses.field(default_factory=ForestConfig)
     strategy: StrategyConfig = dataclasses.field(default_factory=StrategyConfig)
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+    # Scenario engine (scenarios/): noisy oracles, cost-budgeted selection,
+    # rare-event hunting, drifting evaluation streams — perturbations of the
+    # SAME loop, validated at run start (scenarios.validate_scenario) and
+    # inactive ("none") by default, in which case no traced program changes.
+    scenario: ScenarioConfig = dataclasses.field(default_factory=ScenarioConfig)
     # Number of initially-labeled points (Dataset.setStartState nStart,
     # classes/dataset.py:56). The reference seeds 1 positive + 1 negative + extras.
     n_start: int = 10

@@ -206,6 +206,70 @@ def make_striatum_like(
     return x, y
 
 
+def drift_transform(
+    x: jnp.ndarray,
+    step,
+    kind: str = "mean_shift",
+    rate: float = 0.1,
+    direction: jnp.ndarray = None,
+) -> jnp.ndarray:
+    """Apply ``step`` units of distribution drift to a feature batch.
+
+    The shared drift schedule of the scenario engine and the serving drift
+    stream: ``mean_shift`` translates along ``direction`` (unit vector;
+    defaults to the first axis) by ``rate`` per step; ``rotation`` rotates
+    the first two feature coordinates by ``rate`` radians per step about
+    the origin. ``step`` may be a traced scalar (the AL round counter) or a
+    host int (the stream's block index) — one formula either way.
+    """
+    t = jnp.asarray(step, jnp.float32)
+    if kind == "rotation":
+        theta = rate * t
+        c, s = jnp.cos(theta), jnp.sin(theta)
+        x0, x1 = x[..., 0], x[..., 1]
+        return x.at[..., 0].set(c * x0 - s * x1).at[..., 1].set(s * x0 + c * x1)
+    if kind != "mean_shift":
+        raise ValueError(f"unknown drift kind {kind!r}; 'mean_shift' or 'rotation'")
+    d = x.shape[-1]
+    if direction is None:
+        direction = jnp.zeros((d,), jnp.float32).at[0].set(1.0)
+    return x + (rate * t) * direction
+
+
+def make_drifting_stream(
+    key: jax.Array,
+    n_blocks: int,
+    block_rows: int,
+    d: int = 4,
+    kind: str = "mean_shift",
+    rate: float = 0.25,
+    warm_blocks: int = 0,
+):
+    """A drifting ingest stream for the serving scenario tests/benches.
+
+    Yields ``n_blocks`` blocks of ``(x [block_rows, d], y [block_rows])``
+    drawn from the :func:`make_blobs`-style two-class mixture, where block
+    ``i`` past the first ``warm_blocks`` is drifted by ``i - warm_blocks``
+    steps of :func:`drift_transform` — the synthetic stream that pushes a
+    service's traffic past its cold-start quantile edges (the bin-edge
+    refresh trigger in serving/tenants.py). Labels stay a function of the
+    PRE-drift coordinates: the world moves under the model, exactly the
+    covariate-shift regime the refresh exists for.
+    """
+    blocks = []
+    for i in range(n_blocks):
+        k_i = jax.random.fold_in(key, i)
+        k_lab, k_pts = jax.random.split(k_i)
+        y = jax.random.randint(k_lab, (block_rows,), 0, 2)
+        z = jax.random.normal(k_pts, (block_rows, d), dtype=jnp.float32)
+        x = z + 2.0 * y[:, None].astype(jnp.float32)
+        step = max(i - warm_blocks, 0)
+        if step > 0:
+            x = drift_transform(x, step, kind=kind, rate=rate)
+        blocks.append((x.astype(jnp.float32), y.astype(jnp.int32)))
+    return blocks
+
+
 def make_random_matrix(key: jax.Array, n: int, d: int) -> jnp.ndarray:
     """Dense random matrix like ``sqgen.py`` (vectors_50000x1000.txt) /
     ``cosine_similarity.py:26`` (3000x500 random vectors)."""

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 from typing import Tuple
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -45,6 +46,60 @@ def select_bottom_k(
     masked = jnp.where(unlabeled_mask, scores, POS_INF)
     vals, idx = lax.top_k(-masked, k)
     return -vals, idx
+
+
+def knapsack_top_k(
+    scores: jnp.ndarray,
+    costs: jnp.ndarray,
+    unlabeled_mask: jnp.ndarray,
+    k: int,
+    budget: float,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Greedy budget-constrained selection: up to ``k`` unlabeled points by
+    score-per-cost ratio under a spend cap (the cost_budget scenario's
+    selection kernel, scenarios/engine.py).
+
+    Each of the ``k`` greedy steps picks the highest ``score/cost`` ratio
+    among the points still AFFORDABLE under the remaining-budget carry, then
+    deducts that point's cost; once nothing affordable remains, the tail
+    steps emit sentinel picks (``keep=False``, value ``NEG_INF``, index
+    redirected at position 0 — the reveal's masked write ignores them
+    either way, the :func:`select_top_k` tail contract).
+
+    Assumes nonnegative, higher-is-better scores and strictly positive
+    costs (validated at config time, ``scenarios.validate_scenario``):
+    ratio-greedy ordering is only meaningful there. Ties break to the
+    lowest pool index (``argmax`` semantics), matching the host reference
+    in tests/test_scenarios.py exactly — the kernel is pinned exact, not
+    approximate.
+
+    Returns ``(vals [k], idx [k], keep [k] bool, spent scalar f32)``.
+    """
+    ratio = scores / costs
+
+    def step(carry, _):
+        avail, remaining = carry
+        cand = avail & (costs <= remaining)
+        masked = jnp.where(cand, ratio, NEG_INF)
+        i = jnp.argmax(masked)
+        ok = cand[i]  # False iff NO candidate was affordable (argmax of -inf)
+        avail = jnp.where(ok, avail.at[i].set(False), avail)
+        remaining = remaining - jnp.where(ok, costs[i], 0.0)
+        val = jnp.where(ok, scores[i], NEG_INF)
+        return (avail, remaining), (val, i, ok)
+
+    (_, remaining), (vals, idx, keep) = jax.lax.scan(
+        step,
+        (unlabeled_mask, jnp.asarray(budget, jnp.float32)),
+        None,
+        length=k,
+    )
+    spent = jnp.asarray(budget, jnp.float32) - remaining
+    # Sentinel tail: redirect dropped picks at the first pick (an excluded
+    # or already-dropped target; the masked reveal writes nothing for them)
+    # so downstream pick-indexed gathers stay in-bounds and deterministic.
+    idx = jnp.where(keep, idx, idx[0])
+    return vals, idx, keep, spent
 
 
 def merge_tile_topk(

@@ -99,6 +99,45 @@ def build_parser() -> argparse.ArgumentParser:
         "JVM-fit equivalent) or device (jitted histogram trainer; the whole "
         "round runs as device programs)",
     )
+    # Scenario engine (scenarios/): perturb the loop without forking it.
+    ap.add_argument(
+        "--scenario", default="none",
+        choices=["none", "noisy_oracle", "cost_budget", "rare_event", "drift"],
+        help="run the experiment under a scenario (scenarios/): noisy_oracle "
+        "(label flips + probabilistic abstaining reveal — budget accounting "
+        "counts REVEALED labels; --rounds required when abstaining), "
+        "cost_budget (per-point labeling costs, greedy knapsack top-k under "
+        "a per-round spend cap), rare_event (recall-at-budget of the rare "
+        "class rides RoundMetrics), drift (the test stream drifts per round "
+        "index). Needs --fit device; with --sweep-seeds the run routes "
+        "through the grid launcher (scenario x seed)",
+    )
+    ap.add_argument(
+        "--scenarios", default=None, metavar="A,B,...",
+        help="comma-separated scenario list: adds a SCENARIO axis to the "
+        "grid launch (scenario x strategy x seed [x dataset] as one "
+        "pipelined stream; runtime/sweep.py run_grid). Entries share the "
+        "scenario knobs below; 'none' cells stay bit-identical to the "
+        "clean grid. Overrides --scenario",
+    )
+    ap.add_argument("--flip-prob", type=float, default=0.0,
+                    help="noisy_oracle: per-point label-flip probability")
+    ap.add_argument("--abstain-prob", type=float, default=0.0,
+                    help="noisy_oracle: per-reveal abstain probability")
+    ap.add_argument("--cost-budget", type=float, default=0.0,
+                    help="cost_budget: per-round labeling spend cap")
+    ap.add_argument("--cost-spread", type=float, default=4.0,
+                    help="cost_budget: synthetic costs in [1, 1+spread]")
+    ap.add_argument("--rare-class", type=int, default=1,
+                    help="rare_event: the hunted class id")
+    ap.add_argument("--drift-kind", choices=["mean_shift", "rotation"],
+                    default="mean_shift")
+    ap.add_argument("--drift-rate", type=float, default=0.0,
+                    help="drift: per-round drift magnitude")
+    ap.add_argument("--scenario-seed", type=int, default=0,
+                    help="seed for scenario randomness (flips, costs, drift "
+                    "direction) — separate from --seed so clean cells' PRNG "
+                    "streams are untouched")
     ap.add_argument("--n-start", type=int, default=10)
     ap.add_argument("--rounds", type=int, default=None)
     ap.add_argument("--budget", type=int, default=None, help="stop at N labeled")
@@ -340,6 +379,70 @@ def main(argv=None) -> int:
             "without it (ROADMAP: serving the megakernel from the batched "
             "launchers is a follow-up)"
         )
+    # Scenario engine flags (scenarios/): one base ScenarioConfig carries the
+    # knobs; --scenarios crosses kinds into a grid axis sharing those knobs.
+    from distributed_active_learning_tpu.config import ScenarioConfig
+
+    base_scenario = ScenarioConfig(
+        kind=args.scenario,
+        flip_prob=args.flip_prob,
+        abstain_prob=args.abstain_prob,
+        cost_budget=args.cost_budget,
+        cost_spread=args.cost_spread,
+        rare_class=args.rare_class,
+        drift_kind=args.drift_kind,
+        drift_rate=args.drift_rate,
+        seed=args.scenario_seed,
+    )
+    scenario_names = (
+        [s.strip() for s in args.scenarios.split(",") if s.strip()]
+        if args.scenarios else None
+    )
+    scenario_cfgs = None
+    if scenario_names is not None:
+        from distributed_active_learning_tpu.scenarios import (
+            SCENARIO_KINDS,
+            scenario_from_name,
+        )
+
+        unknown = [s for s in scenario_names if s not in SCENARIO_KINDS]
+        if unknown:
+            ap.error(
+                f"unknown scenarios {unknown}; one of {list(SCENARIO_KINDS)}"
+            )
+        if len(set(scenario_names)) != len(scenario_names):
+            ap.error(f"duplicate scenarios in --scenarios: {scenario_names}")
+        scenario_cfgs = [
+            scenario_from_name(s, base_scenario) for s in scenario_names
+        ]
+    scenario_on = scenario_cfgs is not None and any(
+        s.active for s in scenario_cfgs
+    )
+    if scenario_cfgs is not None and not scenario_on:
+        scenario_cfgs = None  # `--scenarios none` IS the clean grid
+    scenario_on = scenario_on or base_scenario.active
+    if scenario_on:
+        if args.neural or args.strategy.startswith("deep."):
+            ap.error(
+                "scenarios drive the forest loop; the neural path has no "
+                "scenario wiring yet (a named ROADMAP follow-up)"
+            )
+        if args.fused_round:
+            ap.error(
+                "--fused-round fuses the CLEAN eval->score->top-k chain; "
+                "scenarios perturb the round body (probabilistic reveal / "
+                "knapsack select / drifted eval) — drop one of the two"
+            )
+        if args.fit != "device":
+            ap.error(
+                "scenarios run inside the jitted round and need --fit device"
+            )
+        if args.mesh_data * args.mesh_model > 1:
+            ap.error(
+                "scenarios are single-device for now (the sharded scenario "
+                "round rides the pod-sharding ROADMAP item)"
+            )
+
     # The neural (deep-AL) loop runs only when asked for explicitly: via
     # --neural or a namespaced "deep.*" strategy name. Names living in both
     # registries (e.g. "entropy") default to the classic forest path, which is
@@ -463,6 +566,14 @@ def main(argv=None) -> int:
             options=_parse_strategy_options(args.strategy_option),
         ),
         mesh=MeshConfig(data=args.mesh_data, model=args.mesh_model),
+        # The single-scenario spelling rides the config; the --scenarios AXIS
+        # rides run_grid's scenarios= parameter instead (the base cfg stays
+        # clean so config-derived identities anchor on the shared knobs).
+        scenario=(
+            base_scenario
+            if base_scenario.active and scenario_cfgs is None
+            else ScenarioConfig()
+        ),
         n_start=args.n_start,
         max_rounds=args.rounds,
         label_budget=args.budget,
@@ -477,7 +588,15 @@ def main(argv=None) -> int:
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every=args.checkpoint_every,
     )
-    use_grid = grid_strategies is not None or grid_datasets is not None
+    use_grid = (
+        grid_strategies is not None
+        or grid_datasets is not None
+        or scenario_cfgs is not None
+        # a single active scenario with a seed sweep routes through the grid
+        # launcher too: the batched seed sweep has no scenario wiring, the
+        # grid's S=1 shape is exactly a scenario x seed sweep
+        or (base_scenario.active and args.sweep_seeds > 1)
+    )
     if args.audit:
         # A --datasets-only (or single-entry --strategies) invocation still
         # launches the grid program, so the audit must trace the grid chunk —
@@ -500,6 +619,7 @@ def main(argv=None) -> int:
                     grid_strategies or [cfg.strategy.name],
                     seeds,
                     datasets=grid_datasets,
+                    scenarios=scenario_cfgs,
                     debugger=dbg,
                     metrics=writer,
                 )
@@ -826,26 +946,32 @@ def _emit_grid(args, grid, dbg):
 
     datasets = sorted({c.dataset for c in grid.cells})
     with_ds = len(datasets) > 1
+    scenarios = sorted({getattr(c, "scenario", "none") for c in grid.cells})
+    with_scn = scenarios != ["none"]
     for cell in grid.cells:
+        scn = getattr(cell, "scenario", "none")
         if args.json:
             for r in cell.result.records:
-                sys.stdout.write(
-                    json.dumps({
-                        "strategy": cell.strategy,
-                        "dataset": cell.dataset,
-                        "seed": cell.seed,
-                        **dc.asdict(r),
-                    }) + "\n"
-                )
+                row = {
+                    "strategy": cell.strategy,
+                    "dataset": cell.dataset,
+                    "seed": cell.seed,
+                }
+                if with_scn:
+                    row["scenario"] = scn
+                sys.stdout.write(json.dumps({**row, **dc.asdict(r)}) + "\n")
         else:
+            sc = f"/{scn}" if with_scn else ""
             sys.stdout.write(
-                f"# grid cell {cell.strategy}/{cell.dataset}/seed {cell.seed}\n"
+                f"# grid cell {cell.strategy}/{cell.dataset}{sc}"
+                f"/seed {cell.seed}\n"
             )
             sys.stdout.write(cell.result.to_reference_log())
         if args.out:
             cell.result.save(
                 _grid_result_path(
-                    args.out, cell.strategy, cell.dataset, cell.seed, with_ds
+                    args.out, cell.strategy, cell.dataset, cell.seed, with_ds,
+                    scenario=scn, with_scenario=with_scn,
                 ),
                 fmt="reference",
             )
@@ -867,9 +993,11 @@ def _emit_grid(args, grid, dbg):
             f"{np.std(finals) * 100:.2f}%"
             if finals else "no accuracy records"
         )
+        scn_part = f" x {len(scenarios)} scenarios" if with_scn else ""
         print(
             f"# grid final: {len(grid.cells)} cells "
-            f"({len(strategies)} strategies x {len(datasets)} datasets), "
+            f"({len(strategies)} strategies x {len(datasets)} datasets"
+            f"{scn_part}), "
             f"{acc}, "
             f"launches={grid.launches} "
             f"recompiles_after_warmup={grid.recompiles_after_warmup}, "

@@ -88,6 +88,14 @@ def _forest_ident(cfg, with_mesh: bool) -> dict:
         "n_start": cfg.n_start,
         "seed": cfg.seed,
     }
+    # An inactive scenario (kind "none", the default) stays out of the
+    # identity — the quantize="none" convention — so every pre-scenario
+    # checkpoint keeps its fingerprint and a scenario-disabled run is
+    # bit-identical to pre-scenario launches. An ACTIVE scenario changes the
+    # oracle/selection/eval semantics, so it participates fully.
+    scn = getattr(cfg, "scenario", None)
+    if scn is not None and getattr(scn, "kind", "none") != "none":
+        ident["scenario"] = dataclasses.asdict(scn)
     if with_mesh:
         ident["mesh"] = dataclasses.asdict(cfg.mesh)
     return ident
@@ -490,7 +498,7 @@ def restore_latest_sweep(
 _GRID_STEP_RE = re.compile(r"^gridstate_(\d+)\.npz$")
 
 
-def grid_fingerprint(cfg, strategies, seeds, datasets, windows) -> str:
+def grid_fingerprint(cfg, strategies, seeds, datasets, windows, scenarios=None) -> str:
     """Identity hash of a grid launch (runtime/sweep.py ``run_grid``): the
     sweep fingerprint extended with the strategy and dataset axes. The file
     stores every cell's state positionally in (strategy, dataset, seed)
@@ -512,6 +520,13 @@ def grid_fingerprint(cfg, strategies, seeds, datasets, windows) -> str:
         "datasets": [str(d) for d in datasets],
         "windows": [int(w) for w in windows],
     }
+    # The scenario axis participates only when present (the fingerprint of a
+    # scenario-free grid is unchanged — the quantize="none"/_forest_ident
+    # convention): cell states are stored positionally in (scenario,
+    # strategy, dataset, seed) order, so a scenario grid must only resume
+    # the same scenario axis.
+    if scenarios:
+        ident["grid"]["scenarios"] = [str(s) for s in scenarios]
     return fingerprint_from_ident(ident)
 
 

@@ -46,6 +46,10 @@ def _round_core(
     aux: StrategyAux,
     window=None,
     fused: bool = False,
+    scenario=None,
+    costs=None,
+    emit_rare: bool = False,
+    emit_cost: bool = False,
 ):
     """The AL round body shared by the plain and padded round functions.
 
@@ -59,13 +63,43 @@ def _round_core(
     ops/topk.py's short-window sentinels (values to +/-inf, indices onto an
     already-excluded pick), so the metrics' finite-pick filter and the
     margin's candidate set both match a serial run at that window bit-for-bit.
+
+    ``scenario`` (a static :class:`~config.ScenarioConfig`, or None) is the
+    scenario engine's hook (scenarios/): ``cost_budget`` swaps the top-k for
+    the greedy knapsack (``costs`` is the per-point cost vector; unaffordable
+    picks are neutralized exactly like short-window sentinels),
+    ``noisy_oracle`` makes the reveal probabilistic (the abstain draw comes
+    from a THIRD split of the carried key — the clean two-way split is
+    untouched when no abstention is configured), and ``rare_event`` /
+    ``emit_rare``/``emit_cost`` attach the scenario metrics to the
+    RoundMetrics pytree (the emit flags let a mixed-scenario grid keep one
+    uniform ys structure across groups; run_grid filters per cell). With
+    ``scenario=None`` every branch below reduces to the pre-scenario body,
+    byte-identically.
     """
-    key, k_score = jax.random.split(state.key)
+    scn_active = scenario is not None and scenario.active
+    abstain = (
+        scenario.abstain_prob
+        if scn_active and scenario.kind == "noisy_oracle"
+        else 0.0
+    )
+    if abstain > 0.0:
+        # The scenario's per-round abstain key: a third split so the score
+        # key and the carried stream stay on the clean path's lattice only
+        # when no abstention is configured (a scenario run may diverge from
+        # clean — it is a different oracle — but must agree with ITS OWN
+        # serial twin, which runs this same body).
+        key, k_score, k_abstain = jax.random.split(state.key, 3)
+    else:
+        key, k_score = jax.random.split(state.key)
+        k_abstain = None
     state = state.replace(key=key)
     # unlabeled_mask (not ~labeled_mask): streaming slab pools additionally
     # exclude allocated-but-unfilled rows past the dynamic fill watermark;
     # for batch pools (n_filled is None) this is the same expression.
     unlabeled = state.unlabeled_mask
+    spent = None
+    cost_keep = None
     if fused:
         # Round megakernel (ops/round_fused.py): eval -> score -> top-k in
         # one pass over the pool slab; same (vals, picked) contract as the
@@ -81,6 +115,15 @@ def _round_core(
                 forest, state.x, unlabeled, strategy.name, window_size
             )
         scores = None
+    elif scn_active and scenario.kind == "cost_budget":
+        from distributed_active_learning_tpu.ops.topk import knapsack_top_k
+
+        with jax.named_scope("al/score"):
+            scores = strategy.score(forest, state, k_score, aux)
+        with jax.named_scope("al/select_knapsack"):
+            vals, picked, cost_keep, spent = knapsack_top_k(
+                scores, costs, unlabeled, window_size, scenario.cost_budget
+            )
     else:
         with jax.named_scope("al/score"):
             scores = strategy.score(forest, state, k_score, aux)
@@ -89,18 +132,36 @@ def _round_core(
                 vals, picked = select_top_k(scores, unlabeled, window_size)
             else:
                 vals, picked = select_bottom_k(scores, unlabeled, window_size)
-    if window is None:
-        with jax.named_scope("al/reveal"):
-            new_state = state_lib.reveal(state, picked)
-    else:
+    keep = None
+    if window is not None:
+        keep = jnp.arange(window_size) < window
+    if cost_keep is not None:
+        keep = cost_keep if keep is None else (keep & cost_keep)
+        # Spend accounted over the FINAL kept picks — under a padded window
+        # (the grid's heterogeneous-window discipline) the knapsack ran at
+        # the pad width, and picks masked out by a narrower cell's window
+        # are never revealed, so they must not consume reported budget.
+        # Dropped picks carry keep=False and contribute zero regardless of
+        # their redirected index; one formula for serial and grid keeps
+        # cost_spent bit-identical between the two drivers.
+        spent = jnp.sum(jnp.where(keep, costs[picked], 0.0))
+    if keep is not None:
         from distributed_active_learning_tpu.ops.topk import NEG_INF, POS_INF
 
-        keep = jnp.arange(window_size) < window
         sentinel = NEG_INF if strategy.higher_is_better else POS_INF
         vals = jnp.where(keep, vals, sentinel)
         picked = jnp.where(keep, picked, picked[0])
-        with jax.named_scope("al/reveal"):
-            new_state = state_lib.reveal_masked(state, picked, keep)
+    with jax.named_scope("al/reveal"):
+        if keep is None and k_abstain is None:
+            new_state = state_lib.reveal(state, picked)
+        else:
+            if keep is None:
+                keep = jnp.ones(picked.shape, dtype=bool)
+            new_state = state_lib.reveal_masked(
+                state, picked, keep,
+                abstain_key=k_abstain,
+                abstain_prob=abstain,
+            )
     if not with_metrics:
         return new_state, picked, scores
     from distributed_active_learning_tpu.runtime import telemetry
@@ -110,6 +171,24 @@ def _round_core(
         higher_is_better=strategy.higher_is_better,
         n_classes=n_classes,
     )
+    want_rare = emit_rare or (scn_active and scenario.kind == "rare_event")
+    want_cost = emit_cost or (scn_active and scenario.kind == "cost_budget")
+    if want_rare or want_cost:
+        from distributed_active_learning_tpu.scenarios import engine as scn_engine
+
+        if want_rare:
+            rm = rm.replace(
+                rare_recall=scn_engine.rare_recall(
+                    new_state.labeled_mask, state.oracle_y, state.valid_mask,
+                    scenario.rare_class if scn_active else 1,
+                )
+            )
+        if want_cost:
+            rm = rm.replace(
+                cost_spent=(
+                    spent if spent is not None else jnp.asarray(0.0, jnp.float32)
+                )
+            )
     return new_state, picked, scores, rm
 
 
@@ -119,6 +198,9 @@ def make_round_fn(
     with_metrics: bool = False,
     n_classes: int = 2,
     fused: bool = False,
+    scenario=None,
+    emit_rare: bool = False,
+    emit_cost: bool = False,
 ):
     """Build the jitted AL round: score pool -> masked top-k -> reveal.
 
@@ -136,6 +218,11 @@ def make_round_fn(
     ``with_metrics`` (the metrics reductions need the score vector the fused
     round never materializes); callers validate via
     :func:`_fused_round_reason` before asking.
+
+    ``scenario`` wires the scenario engine into the round body (see
+    :func:`_round_core`). A ``cost_budget`` scenario changes the signature to
+    ``round_fn(forest, state, aux, costs)`` — the per-point cost vector is a
+    pool-shaped runtime input, not a compile-time constant.
     """
     if fused and with_metrics:
         raise ValueError(
@@ -143,15 +230,33 @@ def make_round_fn(
             "consume the full score vector the megakernel avoids "
             "materializing — drop collect_metrics/--metrics-out or fused_round"
         )
+    with_costs = scenario is not None and scenario.kind == "cost_budget"
 
-    @jax.jit
-    def round_fn(
-        forest: forest_eval.Forest, state: state_lib.PoolState, aux: StrategyAux
-    ):
-        return _round_core(
-            strategy, window_size, with_metrics, n_classes, forest, state, aux,
-            fused=fused,
-        )
+    if with_costs:
+        @jax.jit
+        def round_fn(
+            forest: forest_eval.Forest,
+            state: state_lib.PoolState,
+            aux: StrategyAux,
+            costs: jnp.ndarray,
+        ):
+            return _round_core(
+                strategy, window_size, with_metrics, n_classes, forest, state,
+                aux, fused=fused, scenario=scenario, costs=costs,
+                emit_rare=emit_rare, emit_cost=emit_cost,
+            )
+    else:
+        @jax.jit
+        def round_fn(
+            forest: forest_eval.Forest,
+            state: state_lib.PoolState,
+            aux: StrategyAux,
+        ):
+            return _round_core(
+                strategy, window_size, with_metrics, n_classes, forest, state,
+                aux, fused=fused, scenario=scenario,
+                emit_rare=emit_rare, emit_cost=emit_cost,
+            )
 
     return round_fn
 
@@ -161,6 +266,9 @@ def make_padded_round_fn(
     window_pad: int,
     with_metrics: bool = False,
     n_classes: int = 2,
+    scenario=None,
+    emit_rare: bool = False,
+    emit_cost: bool = False,
 ):
     """:func:`make_round_fn` with a per-call reveal width.
 
@@ -170,19 +278,39 @@ def make_padded_round_fn(
     ``window`` picks. The batched-sweep driver vmaps this over experiments so
     one compiled program serves heterogeneous window sizes; with
     ``window == window_pad`` it is bit-identical to :func:`make_round_fn`.
-    """
 
-    @jax.jit
-    def round_fn(
-        forest: forest_eval.Forest,
-        state: state_lib.PoolState,
-        aux: StrategyAux,
-        window: jnp.ndarray,
-    ):
-        return _round_core(
-            strategy, window_pad, with_metrics, n_classes, forest, state, aux,
-            window=window,
-        )
+    ``scenario``/``emit_*`` mirror :func:`make_round_fn`; a ``cost_budget``
+    scenario appends the per-point ``costs`` vector to the signature.
+    """
+    with_costs = scenario is not None and scenario.kind == "cost_budget"
+
+    if with_costs:
+        @jax.jit
+        def round_fn(
+            forest: forest_eval.Forest,
+            state: state_lib.PoolState,
+            aux: StrategyAux,
+            window: jnp.ndarray,
+            costs: jnp.ndarray,
+        ):
+            return _round_core(
+                strategy, window_pad, with_metrics, n_classes, forest, state,
+                aux, window=window, scenario=scenario, costs=costs,
+                emit_rare=emit_rare, emit_cost=emit_cost,
+            )
+    else:
+        @jax.jit
+        def round_fn(
+            forest: forest_eval.Forest,
+            state: state_lib.PoolState,
+            aux: StrategyAux,
+            window: jnp.ndarray,
+        ):
+            return _round_core(
+                strategy, window_pad, with_metrics, n_classes, forest, state,
+                aux, window=window, scenario=scenario,
+                emit_rare=emit_rare, emit_cost=emit_cost,
+            )
 
     return round_fn
 
@@ -198,6 +326,14 @@ def _fused_round_reason(
     """
     from distributed_active_learning_tpu.ops import round_fused
 
+    scn = getattr(cfg, "scenario", None)
+    if scn is not None and scn.active:
+        return (
+            f"scenario {scn.kind!r} perturbs the round body (probabilistic "
+            "reveal / knapsack selection / drifted eval); the megakernel "
+            "fuses the clean eval -> score -> top-k chain only — a fused "
+            "scenario spelling is a named ROADMAP follow-up"
+        )
     if not round_fused.supports(cfg.strategy.name):
         return (
             f"strategy {cfg.strategy.name!r} is not a pure vote-fraction "
@@ -454,6 +590,7 @@ def make_chunk_fn(
     donate: bool = True,
     stream_cb=None,
     fused_round: bool = False,
+    scenario=None,
 ):
     """Fuse ``chunk_size`` AL rounds into ONE jitted ``lax.scan`` program.
 
@@ -511,22 +648,27 @@ def make_chunk_fn(
     ``donate=False``. NOTE the donated ``labeled_mask`` may be aliased by
     ``aux.seed_mask`` at round 0; the driver copies the seed mask before the
     first launch for exactly this reason.
+
+    ``scenario`` (a :class:`~config.ScenarioConfig`, or None) routes the
+    scenario engine through the scan body: the round runs the scenario
+    round (:func:`_round_core`), a ``drift`` scenario transforms the test
+    batch per round index BEFORE the in-scan accuracy pass
+    (``scenarios.drift_apply`` at the carry's round counter), and the
+    chunk's signature gains a trailing ``costs`` argument (the per-point
+    cost vector; pass None for non-cost scenarios). With ``scenario=None``
+    the signature and traced program are byte-identical to the pre-scenario
+    chunk. The stop scalar semantics are UNCHANGED by design:
+    ``n_labeled_after`` reduces the labeled mask, so an abstaining oracle's
+    budget accounting counts revealed labels, never picks.
     """
     round_fn = make_round_fn(
         strategy, window_size, with_metrics=with_metrics, n_classes=n_classes,
-        fused=fused_round,
+        fused=fused_round, scenario=scenario,
     )
+    scn_active = scenario is not None and scenario.active
+    with_costs = scenario is not None and scenario.kind == "cost_budget"
 
-    @functools.partial(jax.jit, donate_argnums=(1,) if donate else ())
-    def chunk_fn(
-        codes: jnp.ndarray,
-        state: state_lib.PoolState,
-        aux: StrategyAux,
-        fit_key: jax.Array,
-        test_x: jnp.ndarray,
-        test_y: jnp.ndarray,
-        end_round: jnp.ndarray,
-    ):
+    def chunk_body(codes, state, aux, fit_key, test_x, test_y, end_round, costs):
         def body(carry: state_lib.PoolState, _):
             n_labeled = state_lib.labeled_count(carry)
             active = (n_labeled < label_cap) & (carry.round < end_round)
@@ -545,11 +687,19 @@ def make_chunk_fn(
                     )
 
                     forest = attach_mesh(forest, mesh)
+            round_args = (forest, carry, aux) + ((costs,) if with_costs else ())
             if with_metrics:
-                new_state, picked, _, rm = round_fn(forest, carry, aux)
+                new_state, picked, _, rm = round_fn(*round_args)
             else:
-                new_state, picked, _ = round_fn(forest, carry, aux)
-            acc = _accuracy(forest, test_x, test_y)
+                new_state, picked, _ = round_fn(*round_args)
+            eval_x = test_x
+            if scn_active and scenario.kind == "drift":
+                from distributed_active_learning_tpu.scenarios import (
+                    engine as scn_engine,
+                )
+
+                eval_x = scn_engine.drift_apply(scenario, test_x, carry.round)
+            acc = _accuracy(forest, eval_x, test_y)
             out = state_lib.select_state(active, new_state, carry)
             if stream_cb is not None:
                 jax.debug.callback(stream_cb, carry.round + 1, n_labeled, acc, active)
@@ -566,6 +716,36 @@ def make_chunk_fn(
             n_active=jnp.sum(ys[4].astype(jnp.int32)),
         )
         return out_state, extras, ys
+
+    if scenario is not None:
+        @functools.partial(jax.jit, donate_argnums=(1,) if donate else ())
+        def chunk_fn(
+            codes: jnp.ndarray,
+            state: state_lib.PoolState,
+            aux: StrategyAux,
+            fit_key: jax.Array,
+            test_x: jnp.ndarray,
+            test_y: jnp.ndarray,
+            end_round: jnp.ndarray,
+            costs,
+        ):
+            return chunk_body(
+                codes, state, aux, fit_key, test_x, test_y, end_round, costs
+            )
+    else:
+        @functools.partial(jax.jit, donate_argnums=(1,) if donate else ())
+        def chunk_fn(
+            codes: jnp.ndarray,
+            state: state_lib.PoolState,
+            aux: StrategyAux,
+            fit_key: jax.Array,
+            test_x: jnp.ndarray,
+            test_y: jnp.ndarray,
+            end_round: jnp.ndarray,
+        ):
+            return chunk_body(
+                codes, state, aux, fit_key, test_x, test_y, end_round, None
+            )
 
     return chunk_fn
 
@@ -644,6 +824,38 @@ def run_experiment(
     strategy = get_strategy(cfg.strategy)
 
     _validate_quantize(cfg)
+    # Scenario engine (scenarios/): validated up front, wired below. The
+    # start-state draw runs on the CLEAN labels above (the grid launcher
+    # seeds cells the same way, so serial and grid cells agree bit-for-bit);
+    # label flips replace the oracle AFTER seeding, costs are a derived
+    # per-point vector, drift transforms the eval batch per round.
+    scn = cfg.scenario if getattr(cfg, "scenario", None) is not None else None
+    scn = scn if (scn is not None and scn.active) else None
+    costs = None
+    if scn is not None:
+        from distributed_active_learning_tpu.scenarios import engine as scn_engine
+
+        scn_engine.validate_scenario(
+            scn, strategy=strategy, max_rounds=cfg.max_rounds
+        )
+        if cfg.forest.fit != "device":
+            raise ValueError(
+                f"scenario {scn.kind!r} runs inside the jitted round and "
+                "needs the device fit; use --fit device"
+            )
+        if cfg.mesh.data * cfg.mesh.model > 1:
+            raise ValueError(
+                f"scenario {scn.kind!r} is single-device for now (the "
+                "sharded scenario round rides the pod-sharding ROADMAP "
+                "item); drop --mesh-data/--mesh-model"
+            )
+        if scn.kind == "noisy_oracle" and scn.flip_prob > 0.0:
+            flips = scn_engine.flip_mask(scn, cfg.seed, state.n_pool)
+            state = state.replace(
+                oracle_y=scn_engine.apply_flips(state.oracle_y, flips, n_classes)
+            )
+        if scn.kind == "cost_budget":
+            costs = scn_engine.make_costs(scn, state.n_pool, cfg.data.name)
     if cfg.fused_round:
         reason = _fused_round_reason(cfg, want_metrics, n_classes)
         if reason is not None:
@@ -693,7 +905,7 @@ def run_experiment(
         round_fn = make_round_fn(
             strategy, cfg.strategy.window_size,
             with_metrics=want_metrics, n_classes=n_classes,
-            fused=cfg.fused_round,
+            fused=cfg.fused_round, scenario=scn,
         )
         place_forest = lambda f: f
 
@@ -809,6 +1021,7 @@ def run_experiment(
             n_classes=n_classes,
             stream_cb=stream_cb,
             fused_round=cfg.fused_round,
+            scenario=scn,
         )
         # The chunk donates the carried state's buffers; at round 0
         # aux.seed_mask aliases state.labeled_mask, and a donated alias would
@@ -826,8 +1039,19 @@ def run_experiment(
         # arithmetic lives in the shared ChunkDriveControl (the neural loop
         # runs the identical logic).
         n_known = int(state_lib.labeled_count(state))
+        # An abstaining oracle reveals FEWER than `window` labels per round,
+        # so the control's label-cap lattice (which assumes window-sized
+        # steps) would overestimate progress and veto dispatches while the
+        # cell still has work — ending the drive early with an empty launch
+        # window. Lattice window 0 disables exactly that veto (stop decisions
+        # still come from the REAL revealed-count scalar), which is what
+        # makes "an all-abstain oracle never terminates a cell early" hold.
+        lattice_window = (
+            0 if (scn is not None and scn.kind == "noisy_oracle"
+                  and scn.abstain_prob > 0.0) else window
+        )
         ctl = pipeline_lib.ChunkDriveControl(
-            K, window, label_cap, cfg.max_rounds, n_known, start_round
+            K, lattice_window, label_cap, cfg.max_rounds, n_known, start_round
         )
         if not ctl.already_done:
             # Projected upper bound on any ACTIVE fit's labeled rows over the
@@ -859,8 +1083,12 @@ def run_experiment(
         state_template = state
         key_impl = jax.random.key_impl(state.key)
 
+        chunk_tail = (costs,) if scn is not None else ()
+
         def dispatch(st, idx):
-            out = chunk_fn(codes, st, aux, fit_key, test_x, test_y, end_round)
+            out = chunk_fn(
+                codes, st, aux, fit_key, test_x, test_y, end_round, *chunk_tail
+            )
             if ckpt_enabled:
                 new_state = out[0]
                 snapshots.take(
@@ -968,7 +1196,8 @@ def run_experiment(
             # pricing input without keeping the initial state alive.
             telemetry.emit_roofline(
                 metrics, launches, chunk_fn,
-                (codes, state, aux, fit_key, test_x, test_y, end_round),
+                (codes, state, aux, fit_key, test_x, test_y, end_round)
+                + chunk_tail,
                 n_devices=mesh.devices.size if mesh is not None else 1,
             )
 
@@ -1010,14 +1239,27 @@ def run_experiment(
         train_time = dbg.records[-1][1]
 
         with dbg.phase("round"):
+            round_args = (forest, state, aux) + (
+                (costs,) if scn is not None and scn.kind == "cost_budget" else ()
+            )
             if want_metrics:
-                state, picked, _, rm = round_fn(forest, state, aux)
+                state, picked, _, rm = round_fn(*round_args)
             else:
-                state, picked, _ = round_fn(forest, state, aux)
+                state, picked, _ = round_fn(*round_args)
             jax.block_until_ready(picked)  # audit: ok[DAL101] — phase timing
         score_time = dbg.records[-1][1]
         with dbg.phase("eval"):
-            acc = float(_accuracy(forest, test_x, test_y))
+            eval_x = test_x
+            if scn is not None and scn.kind == "drift":
+                from distributed_active_learning_tpu.scenarios import (
+                    engine as scn_engine,
+                )
+
+                # round_idx - 1 is the chunk scan's pre-reveal carry.round
+                # for this round — the per-round and chunked drivers must
+                # drift the SAME evaluation batch for a given round.
+                eval_x = scn_engine.drift_apply(scn, test_x, round_idx - 1)
+            acc = float(_accuracy(forest, eval_x, test_y))
         eval_time = dbg.records[-1][1]
         round_dict = None
         if want_metrics:

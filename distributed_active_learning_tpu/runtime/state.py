@@ -200,7 +200,12 @@ def reveal(state: PoolState, picked_idx: jnp.ndarray) -> PoolState:
 
 
 def reveal_masked(
-    state: PoolState, picked_idx: jnp.ndarray, keep: jnp.ndarray
+    state: PoolState,
+    picked_idx: jnp.ndarray,
+    keep: jnp.ndarray,
+    *,
+    abstain_key: Optional[jax.Array] = None,
+    abstain_prob: float = 0.0,
 ) -> PoolState:
     """:func:`reveal` restricted to the picks where ``keep`` is True.
 
@@ -211,6 +216,22 @@ def reveal_masked(
     untouched elsewhere — with ``keep`` all-True this is bit-identical to
     :func:`reveal` (True max x == True), so the homogeneous-window sweep
     reproduces the serial reveal exactly.
+
+    ``abstain_key``/``abstain_prob`` make the reveal PROBABILISTIC (the
+    noisy-oracle scenario, scenarios/engine.py): each kept pick is
+    additionally revealed only with probability ``1 - abstain_prob`` — the
+    per-pick draw comes from ``abstain_key`` (the round's scenario key fed
+    from the scan carry, never from ``state.key``, so the clean PRNG stream
+    is untouched). Abstained picks write nothing: the point stays unlabeled
+    and re-enters the pool next round, which is exactly why budget
+    accounting downstream (``labeled_count``, the chunk's
+    ``ChunkExtras.n_labeled_after`` stop scalar) counts REVEALED labels —
+    it reduces this mask — and never picks. With ``abstain_prob == 0`` the
+    draw is ``uniform >= 0``, identically True, and the mask write matches
+    the deterministic reveal bit-for-bit.
     """
+    if abstain_key is not None:
+        draw = jax.random.uniform(abstain_key, picked_idx.shape)
+        keep = keep & (draw >= abstain_prob)
     mask = state.labeled_mask.at[picked_idx].max(keep)
     return state.replace(labeled_mask=mask, round=state.round + 1)

@@ -328,6 +328,19 @@ def run_sweep(
             )
         return out
 
+    if getattr(cfg, "scenario", None) is not None and cfg.scenario.active:
+        # Scenario runs sweep through the grid launcher (run_grid grew the
+        # scenario axis; a seed sweep is its S=1 shape) — refusing here
+        # instead of silently running the clean batched chunk keeps the
+        # scenario contract loud. run.py routes --scenario --sweep-seeds
+        # through run_grid for exactly this reason.
+        raise ValueError(
+            f"scenario {cfg.scenario.kind!r} is not wired into the batched "
+            "seed sweep; run it as a grid axis (runtime.sweep.run_grid "
+            "scenarios=..., or run.py --scenario with --sweep-seeds, which "
+            "routes there)"
+        )
+
     # The batched chunk needs the whole round device-resident, like the
     # serial chunked driver: host fit and per-phase debugging fall back to E
     # serial runs rather than fail (the sweep entry point always works).
@@ -676,12 +689,13 @@ def run_sweep(
 
 @dataclasses.dataclass
 class GridCell:
-    """One (strategy, dataset, seed) cell of a grid run."""
+    """One (strategy, dataset, seed[, scenario]) cell of a grid run."""
 
     strategy: str
     dataset: str
     seed: int
     window: int
+    scenario: str = "none"
     result: ExperimentResult = dataclasses.field(default_factory=ExperimentResult)
 
 
@@ -697,32 +711,55 @@ class GridResult:
     recompiles_after_warmup: int = 0
     serial_fallback: bool = False
 
-    def cell(self, strategy: str, dataset: str, seed: int) -> GridCell:
+    def cell(
+        self,
+        strategy: str,
+        dataset: str,
+        seed: int,
+        scenario: Optional[str] = None,
+    ) -> GridCell:
         for c in self.cells:
             if (c.strategy, c.dataset, c.seed) == (strategy, dataset, int(seed)):
-                return c
-        raise KeyError(f"no grid cell ({strategy}, {dataset}, {seed})")
+                if scenario is None or c.scenario == scenario:
+                    return c
+        raise KeyError(f"no grid cell ({strategy}, {dataset}, {seed}, {scenario})")
 
-    def results_for(self, strategy: str, dataset: Optional[str] = None):
-        """Per-seed results of one strategy (optionally one dataset) in seed
-        order — the input shape ``results.strategy_curves`` stacks."""
+    def results_for(
+        self,
+        strategy: str,
+        dataset: Optional[str] = None,
+        scenario: Optional[str] = None,
+    ):
+        """Per-seed results of one strategy (optionally one dataset /
+        scenario) in seed order — the input shape
+        ``results.strategy_curves`` stacks."""
         return [
             c.result
             for c in self.cells
-            if c.strategy == strategy and (dataset is None or c.dataset == dataset)
+            if c.strategy == strategy
+            and (dataset is None or c.dataset == dataset)
+            and (scenario is None or c.scenario == scenario)
         ]
 
 
 def _grid_result_path(
-    path: str, strategy: str, dataset: str, seed: int, with_dataset: bool
+    path: str,
+    strategy: str,
+    dataset: str,
+    seed: int,
+    with_dataset: bool,
+    scenario: str = "none",
+    with_scenario: bool = False,
 ) -> str:
     """Per-cell results file: ``curve.txt`` -> ``curve_margin_s3.txt`` (plus
-    the dataset name once the grid has a dataset axis)."""
+    the dataset name once the grid has a dataset axis, and the scenario name
+    once it has a scenario axis)."""
     import os
 
     stem, ext = os.path.splitext(path)
     ds = f"_{dataset}" if with_dataset else ""
-    return f"{stem}_{strategy}{ds}_s{seed}{ext}"
+    sc = f"_{scenario}" if with_scenario else ""
+    return f"{stem}_{strategy}{ds}{sc}_s{seed}{ext}"
 
 
 def _grid_counts(mask: jnp.ndarray, n_valids_cell: jnp.ndarray) -> jnp.ndarray:
@@ -748,6 +785,7 @@ def make_grid_chunk_fn(
     with_metrics: bool = False,
     n_classes: int = 2,
     donate: bool = True,
+    scenarios=None,
 ):
     """One jitted launch advancing the whole S x D x E grid by ``chunk_size``
     rounds.
@@ -771,6 +809,18 @@ def make_grid_chunk_fn(
     ``extras.n_active`` the max active-round count — the exact scalar pair
     ``ChunkDriveControl(label_cap=0, n_known=-max_remaining)`` drives
     through ``run_pipelined`` unchanged.
+
+    ``scenarios`` (one :class:`~config.ScenarioConfig` or None per group,
+    aligned with ``strategies``) is the scenario engine's grid spelling:
+    each group's round runs ITS OWN scenario body (noisy reveal / knapsack
+    select / drifted eval / rare metric — static per group, so inactive
+    groups trace the clean body), the chunk signature gains per-cell label
+    FLIP masks and per-dataset COST vectors as runtime inputs, the
+    accuracy pass moves into the group loop (drift transforms the test
+    batch per group AND per round, so the shared pass cannot serve it),
+    and scenario metrics emit UNIFORMLY across groups (one ys pytree;
+    run_grid filters per cell at touchdown). ``scenarios=None`` keeps the
+    pre-scenario signature and traced program byte-for-byte.
     """
     from distributed_active_learning_tpu.runtime.loop import (
         _accuracy,
@@ -781,15 +831,34 @@ def make_grid_chunk_fn(
     G, D, E = len(strategies), n_datasets, n_seeds
     DE = D * E
     C_ = G * DE
-    round_fns = [
-        make_padded_round_fn(
-            s, window_pad, with_metrics=with_metrics, n_classes=n_classes
-        )
-        for s in strategies
-    ]
+    scn_on = scenarios is not None
+    if scn_on:
+        if len(scenarios) != G:
+            raise ValueError(f"{len(scenarios)} scenarios for {G} strategy groups")
+        from distributed_active_learning_tpu.scenarios import engine as scn_engine
 
-    @functools.partial(jax.jit, donate_argnums=(3,) if donate else ())
-    def grid_chunk_fn(
+        emit_rare = any(
+            s is not None and s.kind == "rare_event" for s in scenarios
+        )
+        emit_cost = any(
+            s is not None and s.kind == "cost_budget" for s in scenarios
+        )
+        round_fns = [
+            make_padded_round_fn(
+                s, window_pad, with_metrics=with_metrics, n_classes=n_classes,
+                scenario=scenarios[i], emit_rare=emit_rare, emit_cost=emit_cost,
+            )
+            for i, s in enumerate(strategies)
+        ]
+    else:
+        round_fns = [
+            make_padded_round_fn(
+                s, window_pad, with_metrics=with_metrics, n_classes=n_classes
+            )
+            for s in strategies
+        ]
+
+    def grid_body(
         codes: jnp.ndarray,      # [D, n, f] per-dataset bin codes
         x: jnp.ndarray,          # [D, n, d] stacked pools
         oracle_y: jnp.ndarray,   # [D, n]
@@ -805,6 +874,8 @@ def make_grid_chunk_fn(
         edges: jnp.ndarray,      # [D, d, bins-1]
         n_valids: jnp.ndarray,   # [D] real pool rows per dataset
         test_ns: jnp.ndarray,    # [D] real test rows per dataset
+        flip_masks=None,         # [C, n] bool per-cell label flips (scenario)
+        costs_ds=None,           # [D, n] f32 per-point label costs (scenario)
     ):
         # Cell-axis <-> dataset-major reshapes for the strategy-independent
         # passes: cells are strategy-major ([G, D, E] in cell order), but the
@@ -821,10 +892,16 @@ def make_grid_chunk_fn(
 
         def body(carry: SweepState, _):
             def fit_one(x_d, oy_d, codes_d, edges_d, nv_d, mask, key, rnd,
-                        fit_key):
+                        fit_key, flip=None):
                 # The cell's PoolState view over its dataset's shared
                 # (stacked) pool arrays — same pytree the serial fit
-                # consumes; heterogeneous widths ride n_filled.
+                # consumes; heterogeneous widths ride n_filled. A scenario
+                # grid's per-cell flip mask corrupts the oracle view here
+                # (never the stored labels), matching the serial driver's
+                # setup-time flip bit-for-bit (all-False rows select every
+                # original element).
+                if flip is not None:
+                    oy_d = scn_engine.apply_flips(oy_d, flip, n_classes)
                 state = state_lib.PoolState(
                     x=x_d, oracle_y=oy_d, labeled_mask=mask, key=key,
                     round=rnd, n_valid_static=static_n_valid,
@@ -859,36 +936,53 @@ def make_grid_chunk_fn(
                 # shared by one cell-axis vmap — the sweep's exact batching
                 # shape, and a materially smaller compile than the nested
                 # form.
+                fit_args = (carry.labeled_mask, carry.key, carry.round, fit_keys)
+                if scn_on:
+                    fit_args = fit_args + (flip_masks,)
                 forests = jax.vmap(
                     functools.partial(
                         fit_one, x[0], oracle_y[0], codes[0], edges[0],
                         n_valids[0],
                     )
-                )(carry.labeled_mask, carry.key, carry.round, fit_keys)
-                accs = jax.vmap(
-                    functools.partial(acc_one, test_x[0], test_y[0], test_ns[0])
-                )(forests)
+                )(*fit_args)
+                if not scn_on:
+                    accs = jax.vmap(
+                        functools.partial(
+                            acc_one, test_x[0], test_y[0], test_ns[0]
+                        )
+                    )(forests)
             else:
-                forests = jax.vmap(
-                    jax.vmap(fit_one, in_axes=(None,) * 5 + (0,) * 4),
-                    in_axes=(0,) * 9,
-                )(
+                n_fit = 5 if scn_on else 4
+                fit_args = (
                     x, oracle_y, codes, edges, n_valids,
                     to_dm(carry.labeled_mask), to_dm(carry.key),
                     to_dm(carry.round), to_dm(fit_keys),
                 )
-                accs = jax.vmap(
-                    jax.vmap(acc_one, in_axes=(None,) * 3 + (0,)),
-                    in_axes=(0,) * 4,
-                )(test_x, test_y, test_ns, forests)
+                if scn_on:
+                    fit_args = fit_args + (to_dm(flip_masks),)
+                forests = jax.vmap(
+                    jax.vmap(fit_one, in_axes=(None,) * 5 + (0,) * n_fit),
+                    in_axes=(0,) * (9 if not scn_on else 10),
+                )(*fit_args)
+                if not scn_on:
+                    accs = jax.vmap(
+                        jax.vmap(acc_one, in_axes=(None,) * 3 + (0,)),
+                        in_axes=(0,) * 4,
+                    )(test_x, test_y, test_ns, forests)
+                    accs = from_dm(accs)
                 forests = jax.tree.map(from_dm, forests)
-                accs = from_dm(accs)
 
             group_states, group_ys = [], []
             for g in range(G):
                 sl = slice(g * DE, (g + 1) * DE)
                 round_fn = round_fns[g]
                 lal_forest = lal_forests[g]
+                scn_g = scenarios[g] if scn_on else None
+                g_cost = scn_g is not None and scn_g.kind == "cost_budget"
+                g_drift = (
+                    scn_g is not None and scn_g.kind == "drift"
+                    and scn_g.drift_rate > 0.0
+                )
 
                 def one(
                     x_d, oy_d, nv_d, forest, acc, mask, key, rnd, seed_mask,
@@ -915,18 +1009,74 @@ def make_grid_chunk_fn(
                         ys = ys + (rm,)
                     return (out.labeled_mask, out.key, out.round), ys
 
+                def one_scn(
+                    x_d, oy_d, nv_d, tx_d, ty_d, tn_d, cost_d,
+                    forest, mask, key, rnd, seed_mask,
+                    window, end_round, cap, flip,
+                    _round_fn=round_fn, _lal=lal_forest, _scn=scn_g,
+                    _g_cost=g_cost, _g_drift=g_drift,
+                ):
+                    # The scenario group's round: flipped oracle view (the
+                    # fit above used the same view), the group's own round
+                    # body (knapsack/abstain live inside _round_fn), and a
+                    # per-round drifted eval — accuracy computed HERE, not
+                    # in a shared pass, because drift is per (group, round).
+                    oy_v = scn_engine.apply_flips(oy_d, flip, n_classes)
+                    state = state_lib.PoolState(
+                        x=x_d, oracle_y=oy_v, labeled_mask=mask, key=key,
+                        round=rnd, n_valid_static=static_n_valid,
+                        n_filled=nv_d if use_fill else None,
+                    )
+                    aux = StrategyAux(lal_forest=_lal, seed_mask=seed_mask)
+                    n_labeled = state_lib.labeled_count(state)
+                    active = (n_labeled < cap) & (rnd < end_round)
+                    round_args = (forest, state, aux, window) + (
+                        (cost_d,) if _g_cost else ()
+                    )
+                    if with_metrics:
+                        new_state, picked, _, rm = _round_fn(*round_args)
+                    else:
+                        new_state, picked, _ = _round_fn(*round_args)
+                    eval_x = (
+                        scn_engine.drift_apply(_scn, tx_d, rnd)
+                        if _g_drift else tx_d
+                    )
+                    if use_test_fill:
+                        acc = _accuracy_masked(forest, eval_x, ty_d, tn_d)
+                    else:
+                        acc = _accuracy(forest, eval_x, ty_d)
+                    out = state_lib.select_state(active, new_state, state)
+                    ys = (rnd + 1, n_labeled, acc, picked, active)
+                    if with_metrics:
+                        ys = ys + (rm,)
+                    return (out.labeled_mask, out.key, out.round), ys
+
                 if D == 1:
                     g_forest = jax.tree.map(lambda l: l[sl], forests)
-                    per_cell = jax.vmap(
-                        functools.partial(
-                            one, x[0], oracle_y[0], n_valids[0],
+                    if scn_on:
+                        per_cell = jax.vmap(
+                            functools.partial(
+                                one_scn, x[0], oracle_y[0], n_valids[0],
+                                test_x[0], test_y[0], test_ns[0], costs_ds[0],
+                            )
                         )
-                    )
-                    (m, k, r), ys = per_cell(
-                        g_forest, accs[sl], carry.labeled_mask[sl],
-                        carry.key[sl], carry.round[sl], seed_masks[sl],
-                        windows[sl], end_rounds[sl], label_caps[sl],
-                    )
+                        (m, k, r), ys = per_cell(
+                            g_forest, carry.labeled_mask[sl],
+                            carry.key[sl], carry.round[sl], seed_masks[sl],
+                            windows[sl], end_rounds[sl], label_caps[sl],
+                            flip_masks[sl],
+                        )
+                    else:
+                        per_cell = jax.vmap(
+                            functools.partial(
+                                one, x[0], oracle_y[0], n_valids[0],
+                            )
+                        )
+                        (m, k, r), ys = per_cell(
+                            g_forest, accs[sl], carry.labeled_mask[sl],
+                            carry.key[sl], carry.round[sl], seed_masks[sl],
+                            windows[sl], end_rounds[sl], label_caps[sl],
+                        )
                     group_states.append((m, k, r))
                     group_ys.append(ys)
                     continue
@@ -938,17 +1088,32 @@ def make_grid_chunk_fn(
 
                 # inner vmap: seeds share their dataset's pool (broadcast);
                 # outer vmap: the dataset axis batches the stacked pools.
-                per_cell = jax.vmap(
-                    jax.vmap(one, in_axes=(None,) * 3 + (0,) * 9),
-                    in_axes=(0,) * 12,
-                )
-                (m, k, r), ys = per_cell(
-                    x, oracle_y, n_valids,
-                    jax.tree.map(cell, forests), cell(accs),
-                    cell(carry.labeled_mask), cell(carry.key),
-                    cell(carry.round), cell(seed_masks),
-                    cell(windows), cell(end_rounds), cell(label_caps),
-                )
+                if scn_on:
+                    per_cell = jax.vmap(
+                        jax.vmap(one_scn, in_axes=(None,) * 7 + (0,) * 9),
+                        in_axes=(0,) * 16,
+                    )
+                    (m, k, r), ys = per_cell(
+                        x, oracle_y, n_valids, test_x, test_y, test_ns,
+                        costs_ds,
+                        jax.tree.map(cell, forests),
+                        cell(carry.labeled_mask), cell(carry.key),
+                        cell(carry.round), cell(seed_masks),
+                        cell(windows), cell(end_rounds), cell(label_caps),
+                        cell(flip_masks),
+                    )
+                else:
+                    per_cell = jax.vmap(
+                        jax.vmap(one, in_axes=(None,) * 3 + (0,) * 9),
+                        in_axes=(0,) * 12,
+                    )
+                    (m, k, r), ys = per_cell(
+                        x, oracle_y, n_valids,
+                        jax.tree.map(cell, forests), cell(accs),
+                        cell(carry.labeled_mask), cell(carry.key),
+                        cell(carry.round), cell(seed_masks),
+                        cell(windows), cell(end_rounds), cell(label_caps),
+                    )
 
                 def flat(leaf):
                     return leaf.reshape((DE,) + leaf.shape[2:])
@@ -978,6 +1143,31 @@ def make_grid_chunk_fn(
         )
         return out_grid, extras, ys
 
+    if scn_on:
+        @functools.partial(jax.jit, donate_argnums=(3,) if donate else ())
+        def grid_chunk_fn(
+            codes, x, oracle_y, grid, seed_masks, lal_forests, fit_keys,
+            windows, test_x, test_y, end_rounds, label_caps, edges,
+            n_valids, test_ns, flip_masks, costs_ds,
+        ):
+            return grid_body(
+                codes, x, oracle_y, grid, seed_masks, lal_forests, fit_keys,
+                windows, test_x, test_y, end_rounds, label_caps, edges,
+                n_valids, test_ns, flip_masks=flip_masks, costs_ds=costs_ds,
+            )
+    else:
+        @functools.partial(jax.jit, donate_argnums=(3,) if donate else ())
+        def grid_chunk_fn(
+            codes, x, oracle_y, grid, seed_masks, lal_forests, fit_keys,
+            windows, test_x, test_y, end_rounds, label_caps, edges,
+            n_valids, test_ns,
+        ):
+            return grid_body(
+                codes, x, oracle_y, grid, seed_masks, lal_forests, fit_keys,
+                windows, test_x, test_y, end_rounds, label_caps, edges,
+                n_valids, test_ns,
+            )
+
     return grid_chunk_fn
 
 
@@ -987,6 +1177,7 @@ def run_grid(
     seeds: Sequence[int],
     datasets: Optional[Sequence[str]] = None,
     windows: Optional[Sequence[int]] = None,
+    scenarios=None,
     bundles=None,
     debugger=None,
     metrics=None,
@@ -1044,9 +1235,61 @@ def run_grid(
     window_pad = max(windows)
     dbg = debugger or Debugger(enabled=False)
 
-    def _cell_cfg(strat, ds, seed, window):
+    # --- the scenario axis (scenarios/) -------------------------------------
+    # Normalized to either None (the clean grid — the pre-scenario path,
+    # byte-identical programs) or a list of ScenarioConfigs crossed with the
+    # strategy axis into scenario-major groups. A lone inactive entry (or a
+    # cfg.scenario of kind "none") IS the clean grid, so `--scenarios none`
+    # launches exactly today's program — the scenario-disabled parity pin.
+    from distributed_active_learning_tpu.config import ScenarioConfig
+
+    if scenarios is None:
+        base_scn = getattr(cfg, "scenario", None)
+        if base_scn is not None and base_scn.active:
+            scenarios = [base_scn]
+    scn_list = None
+    if scenarios is not None:
+        scn_list = [
+            s if isinstance(s, ScenarioConfig) else ScenarioConfig(kind=str(s))
+            for s in scenarios
+        ]
+        if not scn_list:
+            raise ValueError("run_grid scenarios axis must not be empty")
+        kinds = [s.kind for s in scn_list]
+        if len(set(kinds)) != len(kinds):
+            raise ValueError(f"duplicate scenario kinds in grid axis: {kinds}")
+        if not any(s.active for s in scn_list):
+            scn_list = None  # all-none axis == the clean grid
+    scenario_axis = scn_list is not None
+    base_strategies, base_windows = list(strategies), list(windows)
+    group_scns = None
+    if scenario_axis:
+        from distributed_active_learning_tpu.scenarios import engine as scn_engine
+
+        if cfg.forest.fit != "device":
+            raise ValueError(
+                "scenario grid axes run inside the jitted round and need "
+                "the device fit; use --fit device"
+            )
+        if cfg.mesh.data * cfg.mesh.model > 1:
+            raise ValueError(
+                "scenario grid axes are single-device for now (the sharded "
+                "scenario round rides the pod-sharding ROADMAP item)"
+            )
+        # scenario-major groups: cells order (scenario, strategy, dataset,
+        # seed) — one launch produces the scenario x strategy x seed table.
+        strategies = [st for _ in scn_list for st in base_strategies]
+        windows = [w for _ in scn_list for w in base_windows]
+        group_scns = [s for s in scn_list for _ in base_strategies]
+        S = len(strategies)
+
+    def _group_scn(gi: int):
+        return group_scns[gi] if group_scns is not None else None
+
+    def _cell_cfg(strat, ds, seed, window, scn=None):
         import os
 
+        sc_tag = f"_{scn.kind}" if scn is not None and scn.active else ""
         return dataclasses.replace(
             cfg,
             seed=seed,
@@ -1054,20 +1297,32 @@ def run_grid(
             strategy=dataclasses.replace(
                 cfg.strategy, name=strat, window_size=window
             ),
+            scenario=scn if scn is not None else ScenarioConfig(),
             results_path=(
-                _grid_result_path(cfg.results_path, strat, ds, seed, D > 1)
+                _grid_result_path(
+                    cfg.results_path, strat, ds, seed, D > 1,
+                    scenario=scn.kind if scn is not None else "none",
+                    with_scenario=scenario_axis,
+                )
                 if cfg.results_path else None
             ),
             checkpoint_dir=(
-                os.path.join(cfg.checkpoint_dir, f"{strat}_{ds}_seed_{seed}")
+                os.path.join(
+                    cfg.checkpoint_dir, f"{strat}_{ds}{sc_tag}_seed_{seed}"
+                )
                 if cfg.checkpoint_dir else None
             ),
         )
 
     def _cells():
         return [
-            GridCell(strategy=s, dataset=d, seed=e, window=w)
-            for s, w in zip(strategies, windows)
+            GridCell(
+                strategy=s, dataset=d, seed=e, window=w,
+                scenario=(
+                    group_scns[gi].kind if group_scns is not None else "none"
+                ),
+            )
+            for gi, (s, w) in enumerate(zip(strategies, windows))
             for d in datasets
             for e in seeds
         ]
@@ -1085,12 +1340,19 @@ def run_grid(
             )
         return _bundle_cache[name]
 
+    _scn_by_kind = (
+        {s.kind: s for s in scn_list} if scn_list is not None else {}
+    )
+
     def _serial_fallback(reason):
         dbg.debug(f"grid launcher falling back to serial cells: {reason}")
         cells = _cells()
         for c in cells:
             c.result = run_experiment(
-                _cell_cfg(c.strategy, c.dataset, c.seed, c.window),
+                _cell_cfg(
+                    c.strategy, c.dataset, c.seed, c.window,
+                    scn=_scn_by_kind.get(c.scenario),
+                ),
                 bundle=_bundle(c.dataset),
                 debugger=debugger,
                 metrics=metrics,
@@ -1260,6 +1522,43 @@ def run_grid(
             lal_forests.append(None)
     lal_forests = tuple(lal_forests)
 
+    # --- scenario inputs: per-cell flip masks, per-dataset cost vectors -----
+    flip_masks = None
+    costs_ds = None
+    if scenario_axis:
+        # Pairwise validation (the knapsack's score-direction assumption is
+        # per strategy; abstention's termination guard is per run).
+        for scn_g, so in zip(group_scns, strat_objs):
+            scn_engine.validate_scenario(
+                scn_g, strategy=so, max_rounds=cfg.max_rounds
+            )
+        flip_rows = []
+        for gi in range(S):
+            for d in range(D):
+                for seed in seeds:
+                    row = np.asarray(
+                        scn_engine.flip_mask(
+                            group_scns[gi], seed, n_valids_host[d]
+                        )
+                    )
+                    flip_rows.append(np.pad(row, (0, n_slab - n_valids_host[d])))
+        flip_masks = jnp.asarray(np.stack(flip_rows))
+        cost_scn = next((s for s in scn_list if s.kind == "cost_budget"), None)
+        cost_rows = []
+        for d, name in enumerate(datasets):
+            if cost_scn is not None:
+                row = np.asarray(
+                    scn_engine.make_costs(cost_scn, n_valids_host[d], name)
+                )
+            else:
+                row = np.ones(n_valids_host[d], np.float32)
+            cost_rows.append(
+                np.pad(
+                    row, (0, n_slab - n_valids_host[d]), constant_values=1.0
+                )
+            )
+        costs_ds = jnp.asarray(np.stack(cost_rows))
+
     if metrics is not None:
         from distributed_active_learning_tpu.config import asdict as cfg_asdict
 
@@ -1272,6 +1571,9 @@ def run_grid(
             grid_seeds=seeds,
             grid_datasets=datasets,
             grid_windows=windows,
+            grid_scenarios=(
+                [s.kind for s in group_scns] if group_scns is not None else None
+            ),
         )
 
     cells = _cells()
@@ -1284,7 +1586,10 @@ def run_grid(
         from distributed_active_learning_tpu.runtime import checkpoint as ckpt_lib
 
         ckpt_fp = ckpt_lib.grid_fingerprint(
-            cfg, strategies, seeds, datasets, windows
+            cfg, strategies, seeds, datasets, windows,
+            scenarios=(
+                [s.kind for s in scn_list] if scn_list is not None else None
+            ),
         )
         restored = ckpt_lib.restore_latest_grid(
             cfg.checkpoint_dir, n_store=n_store, n_cells=C, fingerprint=ckpt_fp
@@ -1363,6 +1668,7 @@ def run_grid(
         wrap_pallas=(mesh is not None and cfg.forest.kernel == "pallas"),
         with_metrics=want_metrics,
         n_classes=n_classes,
+        scenarios=group_scns,
     )
     launches = telemetry.LaunchTracker(metrics, "grid_chunk_scan", fn=grid_chunk)
 
@@ -1370,10 +1676,19 @@ def run_grid(
     # shared ChunkDriveControl drive per-cell caps — n_known = -max remaining
     # budget, label_cap = 0, so "all cells done" is the existing >= test; the
     # min-window veto lattice under-estimates every cell's progress, hence
-    # stays safe (see make_grid_chunk_fn docstring).
+    # stays safe (see make_grid_chunk_fn docstring). An abstaining-oracle
+    # group breaks the lattice's window-per-round assumption the other way
+    # (reveals may be SMALLER than any window), so its grids run with the
+    # label lattice disabled — stop decisions come from the real revealed
+    # counts, and an all-abstain cell never terminates early.
     rem0 = max(cap - c0 for cap, c0 in zip(caps_host, counts0))
+    lattice_window = min(windows)
+    if group_scns is not None and any(
+        s.kind == "noisy_oracle" and s.abstain_prob > 0.0 for s in group_scns
+    ):
+        lattice_window = 0
     ctl = pipeline_lib.ChunkDriveControl(
-        K, min(windows), 0, cfg.max_rounds, -rem0, max(start_rounds),
+        K, lattice_window, 0, cfg.max_rounds, -rem0, max(start_rounds),
     )
 
     if not ctl.already_done:
@@ -1396,11 +1711,13 @@ def run_grid(
     grid_state = SweepState(labeled_mask=masks0, key=keys0, round=rounds0)
     snapshots = pipeline_lib.CarrySnapshots(ckpt_snapshot)
 
+    grid_tail = (flip_masks, costs_ds) if scenario_axis else ()
+
     def dispatch(gs, idx):
         out = grid_chunk(
             codes, x, oracle_y, gs, seed_masks, lal_forests, fit_keys,
             windows_cell, test_x, test_y, end_rounds, label_caps, edges,
-            n_valids, test_ns,
+            n_valids, test_ns, *grid_tail,
         )
         if ckpt_enabled:
             new_grid = out[0]
@@ -1424,6 +1741,18 @@ def run_grid(
             if want_metrics
             else None
         )
+        if md is not None and group_scns is not None:
+            # Scenario metrics emit UNIFORMLY across groups inside the chunk
+            # (one ys pytree for the merge); a cell only KEEPS the metrics of
+            # its own scenario here, so a none-cell's records match a clean
+            # serial run key-for-key.
+            for c in range(C):
+                kind_c = group_scns[c // (D * E)].kind
+                for m in md[c]:
+                    if kind_c != "rare_event":
+                        m.pop("rare_recall", None)
+                    if kind_c != "cost_budget":
+                        m.pop("cost_spent", None)
         last_round = ctl.round_idx
         for c in range(C):
             act = active_np[:, c]
@@ -1441,6 +1770,9 @@ def run_grid(
             )
             last_round = max(last_round, int(r_c[-1]))
             if metrics is not None:
+                scn_tag = (
+                    {"scenario": cell.scenario} if scenario_axis else {}
+                )
                 for i in range(len(r_c)):
                     metrics.round(
                         exp=c,
@@ -1450,6 +1782,7 @@ def run_grid(
                         round=int(r_c[i]),
                         n_labeled=int(l_c[i]),
                         accuracy=float(a_c[i]),
+                        **scn_tag,
                         **(md[c][i] if md is not None else {}),
                     )
             if cfg.log_every and dbg.enabled:
@@ -1501,7 +1834,8 @@ def run_grid(
         for c in cells:
             c.result.save(
                 _grid_result_path(
-                    cfg.results_path, c.strategy, c.dataset, c.seed, D > 1
+                    cfg.results_path, c.strategy, c.dataset, c.seed, D > 1,
+                    scenario=c.scenario, with_scenario=scenario_axis,
                 ),
                 fmt="reference",
             )

@@ -78,6 +78,12 @@ class RoundMetrics:
     pool_entropy: jnp.ndarray  # mean predictive entropy over valid pool rows (bits)
     labeled_frac: jnp.ndarray  # pre-reveal labeled fraction of the real pool
     picked_hist: jnp.ndarray  # [n_classes] int32 oracle classes of the window
+    # Scenario-engine metrics (scenarios/): None (an absent pytree leaf —
+    # program avals unchanged) unless the matching scenario is active, so the
+    # clean path's metrics pytree stays byte-identical to the pre-scenario
+    # code. The dict converters below skip None fields.
+    rare_recall: Optional[jnp.ndarray] = None  # rare_event: recall-at-budget
+    cost_spent: Optional[jnp.ndarray] = None   # cost_budget: this round's spend
 
 
 def compute_round_metrics(
@@ -215,7 +221,15 @@ def _selection_metrics(
 # The one source of truth for the metric field names — the dict converters
 # below derive from it, so a field added to RoundMetrics cannot silently miss
 # the records/JSONL. picked_hist is the only vector field (list-valued).
+# Optional scenario fields (rare_recall, cost_spent) are None outside their
+# scenario; the converters emit a key only when the leaf exists.
 _METRIC_FIELDS = tuple(f.name for f in RoundMetrics.__dataclass_fields__.values())
+
+
+def _present_fields(host_rm) -> tuple:
+    return tuple(
+        name for name in _METRIC_FIELDS if getattr(host_rm, name) is not None
+    )
 
 
 def _field_to_py(host_rm, name: str, idx=None):
@@ -234,7 +248,7 @@ def metrics_to_dict(rm: RoundMetrics) -> Dict[str, Any]:
     leaf — the per-round driver calls this once per round.
     """
     host = jax.device_get(rm)
-    return {name: _field_to_py(host, name) for name in _METRIC_FIELDS}
+    return {name: _field_to_py(host, name) for name in _present_fields(host)}
 
 
 def stacked_metrics_to_dicts(
@@ -244,8 +258,9 @@ def stacked_metrics_to_dicts(
     one plain dict per ACTIVE round (inactive tail steps are discarded work,
     same as their accuracy/picked ys)."""
     host = jax.device_get(rm_stacked)
+    fields = _present_fields(host)
     return [
-        {name: _field_to_py(host, name, i) for name in _METRIC_FIELDS}
+        {name: _field_to_py(host, name, i) for name in fields}
         for i in np.flatnonzero(np.asarray(active))
     ]
 
@@ -259,9 +274,10 @@ def stacked_sweep_metrics_to_dicts(
     ``device_get`` of the whole stacked pytree, then host-side slicing)."""
     host = jax.device_get(rm_stacked)
     active = np.asarray(active)
+    fields = _present_fields(host)
     return [
         [
-            {name: _field_to_py(host, name, (i, e)) for name in _METRIC_FIELDS}
+            {name: _field_to_py(host, name, (i, e)) for name in fields}
             for i in np.flatnonzero(active[:, e])
         ]
         for e in range(active.shape[1])

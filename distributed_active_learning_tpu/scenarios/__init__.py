@@ -1,0 +1,39 @@
+"""Scenario engine: perturb the AL loop without forking it.
+
+The reference paper exercises only the clean pool-based loop; production
+labeling workloads are messier — oracles flip and abstain, labels have
+costs, the interesting class is rare, and the incoming traffic drifts away
+from the pool the model was fit on. This package lands those four families
+as ONE engine wired into the existing drivers as config + grid axes
+(``ScenarioConfig`` in config.py; ``run.py --scenario/--scenarios``;
+``runtime.sweep.run_grid(scenarios=...)``), each scenario landing in the
+layer it actually stresses:
+
+- **noisy_oracle** — probabilistic reveal inside the jitted round
+  (``runtime.state.reveal_masked`` grew an abstain mask; flips are a
+  per-experiment mask from the scenario seed). Budget accounting counts
+  REVEALED labels (the mask), never picks.
+- **cost_budget** — a greedy knapsack selection kernel
+  (``ops.topk.knapsack_top_k``): score-per-cost under a per-round spend
+  cap, exact against a host reference.
+- **rare_event** — recall-at-budget computed in-scan, riding
+  ``RoundMetrics.rare_recall``.
+- **drift** — the evaluation stream transforms per round index
+  (``drift_apply``; generators in ``data/synthetic.py``); the serving twin
+  is the bin-edge refresh in ``serving/tenants.py``.
+
+Every scenario is OFF by default and, when off, leaves every traced program
+byte-identical to the clean path — pinned by tests/test_scenarios.py.
+"""
+
+from distributed_active_learning_tpu.config import ScenarioConfig  # noqa: F401
+from distributed_active_learning_tpu.scenarios.engine import (  # noqa: F401
+    SCENARIO_KINDS,
+    apply_flips,
+    drift_apply,
+    flip_mask,
+    make_costs,
+    rare_recall,
+    scenario_from_name,
+    validate_scenario,
+)

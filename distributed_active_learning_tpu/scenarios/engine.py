@@ -1,0 +1,200 @@
+"""Scenario-engine primitives: the deterministic transforms every driver
+shares.
+
+Design rules (what keeps scenario cells comparable across drivers):
+
+- **Scenario randomness is keyed separately from the experiment.** Flip
+  masks, cost vectors, and the drift direction derive from
+  ``ScenarioConfig.seed`` (plus the cell's experiment seed / dataset name
+  where per-cell variation is wanted) — never from ``PoolState.key`` — so a
+  scenario=none cell's PRNG stream is untouched and stays bit-identical to
+  the pre-scenario code.
+
+- **Transforms are pure functions of (config, static identity).** The same
+  formula runs host-side (serial setup) and in-trace (the grid chunk), so a
+  grid cell and its serial twin see identical flips/costs/drift — the
+  serial-vs-grid bit-identity tests lean on this.
+
+- **Inactive means absent.** Every helper returns the identity (all-False
+  masks, unit costs, untransformed arrays) for an inactive scenario, and
+  the drivers skip the scenario plumbing entirely when no scenario is
+  active, so the clean path's traced programs never change.
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_active_learning_tpu.config import ScenarioConfig
+
+SCENARIO_KINDS = ("none", "noisy_oracle", "cost_budget", "rare_event", "drift")
+
+#: Domain separator for scenario keys so a scenario seed equal to an
+#: experiment seed still draws an unrelated stream.
+_SALT = 0x5CE7A410
+
+
+def scenario_from_name(name: str, base: Optional[ScenarioConfig] = None) -> ScenarioConfig:
+    """A :class:`ScenarioConfig` of kind ``name``, carrying ``base``'s knobs.
+
+    The CLI's ``--scenarios a,b,c`` axis shares one knob set (--flip-prob,
+    --cost-budget, ...) across entries; this swaps only the kind.
+    """
+    import dataclasses
+
+    if name not in SCENARIO_KINDS:
+        raise ValueError(f"unknown scenario {name!r}; one of {SCENARIO_KINDS}")
+    base = base if base is not None else ScenarioConfig()
+    return dataclasses.replace(base, kind=name)
+
+
+def validate_scenario(scn: ScenarioConfig, *, strategy=None, max_rounds=None) -> None:
+    """Refuse unservable scenario configurations LOUDLY at run start.
+
+    ``strategy`` (a :class:`~strategies.base.Strategy`, optional) gates the
+    knapsack's score-direction assumption; ``max_rounds`` gates the
+    abstaining oracle's termination (an all-abstain oracle never reaches a
+    label budget, so an unbounded run would never stop — by design it never
+    terminates EARLY, so the round quota is the only stop it has).
+    """
+    if scn.kind not in SCENARIO_KINDS:
+        raise ValueError(f"unknown scenario kind {scn.kind!r}; one of {SCENARIO_KINDS}")
+    if not scn.active:
+        return
+    if scn.kind == "noisy_oracle":
+        if not (0.0 <= scn.flip_prob <= 1.0 and 0.0 <= scn.abstain_prob <= 1.0):
+            raise ValueError(
+                f"noisy_oracle needs flip_prob/abstain_prob in [0, 1], got "
+                f"{scn.flip_prob}/{scn.abstain_prob}"
+            )
+        if scn.flip_prob == 0.0 and scn.abstain_prob == 0.0:
+            raise ValueError(
+                "noisy_oracle with flip_prob=0 and abstain_prob=0 is the "
+                "clean oracle; use scenario 'none' or set a probability"
+            )
+        if scn.abstain_prob > 0.0 and max_rounds is None:
+            raise ValueError(
+                "an abstaining oracle may never reach the label budget "
+                "(abstained picks re-enter the pool), so the run needs "
+                "max_rounds as its stop; set --rounds"
+            )
+    elif scn.kind == "cost_budget":
+        if scn.cost_budget <= 0.0:
+            raise ValueError("cost_budget scenario needs cost_budget > 0")
+        if scn.cost_spread < 0.0:
+            raise ValueError(f"cost_spread must be >= 0, got {scn.cost_spread}")
+        if strategy is not None and not strategy.higher_is_better:
+            raise ValueError(
+                f"knapsack selection ranks by score-per-cost and assumes "
+                f"nonnegative higher-is-better scores; strategy "
+                f"{strategy.name!r} selects ascending — use an "
+                "entropy/density-family strategy for cost_budget"
+            )
+    elif scn.kind == "rare_event":
+        if scn.rare_class < 0:
+            raise ValueError(f"rare_class must be >= 0, got {scn.rare_class}")
+    elif scn.kind == "drift":
+        if scn.drift_rate <= 0.0:
+            raise ValueError("drift scenario needs drift_rate > 0")
+        if scn.drift_kind not in ("mean_shift", "rotation"):
+            raise ValueError(
+                f"unknown drift_kind {scn.drift_kind!r}; "
+                "'mean_shift' or 'rotation'"
+            )
+
+
+def _base_key(scn: ScenarioConfig) -> jax.Array:
+    return jax.random.key(np.uint32(scn.seed ^ _SALT))
+
+
+def dataset_fold(name: str) -> int:
+    """Stable per-dataset fold constant (crc32 of the name), so the serial
+    driver and the grid derive identical per-dataset scenario draws."""
+    return zlib.crc32(str(name).encode()) & 0x7FFFFFFF
+
+
+def flip_mask(scn: ScenarioConfig, cell_seed: int, n: int) -> jnp.ndarray:
+    """The per-experiment label-flip mask ``[n] bool``.
+
+    Drawn ONCE per (scenario seed, experiment seed) so repeated oracle
+    queries of one point are consistent — a flipped point is flipped for the
+    whole experiment, like a systematically-wrong annotator. All-False when
+    the scenario has no flips.
+    """
+    if scn.kind != "noisy_oracle" or scn.flip_prob <= 0.0:
+        return jnp.zeros((n,), dtype=bool)
+    key = jax.random.fold_in(_base_key(scn), int(cell_seed))
+    return jax.random.uniform(key, (n,)) < scn.flip_prob
+
+
+def apply_flips(oracle_y: jnp.ndarray, flips: jnp.ndarray, n_classes: int) -> jnp.ndarray:
+    """Oracle labels with the flip mask applied (traced or host).
+
+    Binary pools flip 0<->1; multiclass rotates to the next class — a
+    deterministic wrong answer either way. With an all-False mask the
+    ``where`` selects every original element, bit-identically.
+    """
+    if n_classes <= 2:
+        return jnp.where(flips, 1 - oracle_y, oracle_y)
+    return jnp.where(flips, (oracle_y + 1) % n_classes, oracle_y)
+
+
+def make_costs(scn: ScenarioConfig, n: int, dataset_name: str = "") -> jnp.ndarray:
+    """The per-point labeling-cost vector ``[n] float32`` in
+    ``[1, 1 + cost_spread]``, keyed by (scenario seed, dataset name) so every
+    seed of one dataset prices points identically (costs are a property of
+    the data, not the experiment). Unit costs for non-cost scenarios.
+    """
+    if scn.kind != "cost_budget":
+        return jnp.ones((n,), dtype=jnp.float32)
+    key = jax.random.fold_in(_base_key(scn), dataset_fold(dataset_name))
+    return 1.0 + scn.cost_spread * jax.random.uniform(key, (n,), dtype=jnp.float32)
+
+
+def drift_apply(scn: ScenarioConfig, x: jnp.ndarray, round_: jnp.ndarray) -> jnp.ndarray:
+    """The round-``round_`` drifted view of an evaluation batch (traced).
+
+    One shared schedule implementation (``data.synthetic.drift_transform``
+    — the serving drift stream uses the same formula, so the batch scenario
+    and the service's synthetic traffic cannot drift apart): ``mean_shift``
+    translates along a fixed unit direction drawn from the scenario seed at
+    ``drift_rate`` per round; ``rotation`` rotates the first two feature
+    coordinates by ``drift_rate`` radians per round. Identity for non-drift
+    scenarios. ``round_`` may be a traced scalar (the scan carry's round
+    counter) — the transform stays one fused affine op.
+    """
+    if scn.kind != "drift" or scn.drift_rate <= 0.0:
+        return x
+    from distributed_active_learning_tpu.data.synthetic import drift_transform
+
+    direction = None
+    if scn.drift_kind == "mean_shift":
+        d = x.shape[-1]
+        u = jax.random.normal(_base_key(scn), (d,), dtype=jnp.float32)
+        direction = u / jnp.maximum(jnp.linalg.norm(u), 1e-6)
+    return drift_transform(
+        x, round_, kind=scn.drift_kind, rate=scn.drift_rate,
+        direction=direction,
+    )
+
+
+def rare_recall(
+    labeled_mask: jnp.ndarray,
+    oracle_y: jnp.ndarray,
+    valid_mask: jnp.ndarray,
+    rare_class: int,
+) -> jnp.ndarray:
+    """Recall-at-budget (traced): the fraction of the pool's rare-class
+    points labeled so far. The rare-event scenario's headline — at the
+    budget stop this IS recall-at-budget; earlier rounds trace the curve.
+    An empty rare class reports 0 rather than dividing by zero.
+    """
+    rare = (oracle_y == rare_class) & valid_mask
+    total = jnp.sum(rare.astype(jnp.int32))
+    found = jnp.sum((rare & labeled_mask).astype(jnp.int32))
+    return found.astype(jnp.float32) / jnp.maximum(total, 1).astype(jnp.float32)

@@ -18,11 +18,21 @@ every device-touching operation through ONE dispatcher thread.
   :class:`AdmissionError` — bounded memory, and the backpressure signal a
   client can act on.
 
-- **Per-tenant fairness.** Each dispatch cycle drains AT MOST one score
-  request per tenant, rotating the starting tenant round-robin — a noisy
-  tenant cannot occupy more than its slot in any fused launch while others
-  wait. The collected slots coalesce into ONE cross-tenant batched launch
+- **Per-tenant fairness, weighted by SLO class.** Each dispatch cycle
+  drains AT MOST one score request per tenant, rotating the starting tenant
+  round-robin — a noisy tenant cannot occupy more than its slot in any
+  fused launch while others wait. On top of the rotation, deficit weighted
+  round-robin (``ServeConfig.slo_weight``): a tenant accrues ``weight``
+  credits per contended cycle and a score slot costs 1, so weight 1.0 (the
+  default) is served every cycle — exactly the pre-SLO fair rotation —
+  while weight 0.5 is served every OTHER cycle its queue is nonempty. The
+  collected slots coalesce into ONE cross-tenant batched launch
   (:meth:`~serving.tenants.TenantManager.score_many`).
+
+- **Priority admission.** ``ServeConfig.slo_priority`` scales the admission
+  cap: a priority-``p`` tenant tolerates ``max_pending * (1 + p)`` queued
+  requests before :class:`AdmissionError`, so under global load the lower
+  classes shed first and the gold class keeps enqueueing.
 
 - **Re-fit backpressure.** While a tenant's re-fit chunk is in flight its
   INGEST requests are held (the slab arrays are donation-bound to the
@@ -91,6 +101,11 @@ class ServiceFrontend:
         self.fused_launch_cycles = 0
         self.held_ingest_cycles = 0
         self.rejected: Dict[str, int] = {}
+        # SLO accounting (deficit weighted round-robin; see _credit_ok):
+        # score slots granted / deferred per tenant, and the running credit.
+        self._credits: Dict[str, float] = {}
+        self.slo_served: Dict[str, int] = {}
+        self.slo_deferred: Dict[str, int] = {}
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -130,9 +145,34 @@ class ServiceFrontend:
     # -- client surface ------------------------------------------------------
 
     def _cap_for(self, tenant: str) -> int:
-        if self._max_pending is not None:
-            return self._max_pending
-        return self.manager.tenant(tenant).serve.max_pending
+        serve = self.manager.tenant(tenant).serve
+        base = (
+            self._max_pending
+            if self._max_pending is not None
+            else serve.max_pending
+        )
+        # Priority admission: higher SLO classes tolerate deeper queues, so
+        # under shared load the lower classes hit AdmissionError first.
+        prio = max(int(getattr(serve, "slo_priority", 0)), 0)
+        return base * (1 + prio)
+
+    def _credit_ok(self, tenant: str) -> bool:
+        """Deficit weighted round-robin: accrue ``slo_weight`` credits per
+        contended cycle, spend 1 per score slot. Called at most once per
+        tenant per dispatch cycle (and only when a score is actually
+        queued), so the accrual rate IS the cycle rate. Weight >= 1 is
+        always served (the pre-SLO behavior for the default 1.0); weight w
+        in (0, 1) is served a w fraction of its contended cycles."""
+        serve = self.manager.tenant(tenant).serve
+        w = max(float(getattr(serve, "slo_weight", 1.0)), 0.0)
+        c = min(self._credits.get(tenant, 0.0) + w, max(1.0, w))
+        if c >= 1.0:
+            self._credits[tenant] = c - 1.0
+            self.slo_served[tenant] = self.slo_served.get(tenant, 0) + 1
+            return True
+        self._credits[tenant] = c
+        self.slo_deferred[tenant] = self.slo_deferred.get(tenant, 0) + 1
+        return False
 
     def _enqueue(self, req: _Request) -> Future:
         cap = self._cap_for(req.tenant)
@@ -208,17 +248,18 @@ class ServiceFrontend:
                     # backpressure: hold the ingest, but let a queued score
                     # overtake it — the resident forest stays hot
                     held = True
-                    for i, req in enumerate(q):
-                        if req.kind == "score":
-                            del q[i]
-                            scores[tid] = req
-                            break
+                    if any(r.kind == "score" for r in q) and self._credit_ok(tid):
+                        for i, req in enumerate(q):
+                            if req.kind == "score":
+                                del q[i]
+                                scores[tid] = req
+                                break
                     continue
                 ingests.append(q.popleft())
                 # an ingest and a score from one tenant may share a cycle
-                if q and q[0].kind == "score":
+                if q and q[0].kind == "score" and self._credit_ok(tid):
                     scores[tid] = q.popleft()
-            else:
+            elif self._credit_ok(tid):
                 scores[tid] = q.popleft()
         if n:
             self._rr = (self._rr + 1) % n

@@ -162,6 +162,11 @@ class _CapacityPrograms:
     chunk_tracker: _ProgramTracker
     fit_tracker: _ProgramTracker
     aot: bool = False
+    # The bin-edge epoch these programs were built against (the fit/chunk
+    # closures capture the edges): a drift-triggered bin refresh bumps the
+    # tenant's epoch, and _install_programs rejects stale-epoch sets — an
+    # AOT precompile racing a refresh must never install old-edge programs.
+    edges_epoch: int = 0
 
 
 @dataclasses.dataclass
@@ -180,6 +185,10 @@ class ServeStats:
     # Growths whose new-capacity programs were already resident (the AOT
     # precompile landed in time) — the executable-swap fast path.
     growths_precompiled: int = 0
+    # Drift-triggered bin-edge refreshes (serving scenario follow-up): the
+    # stream drifted past the cold-start quantiles, the binning was
+    # re-quantiled from the live slab, and the forest fingerprint bumped.
+    bin_refreshes: int = 0
 
 
 def _sds(shape, dtype) -> jax.ShapeDtypeStruct:
@@ -276,6 +285,14 @@ class Tenant:
         state0 = state_lib.set_start_state(state0, cfg.n_start, n_classes=self.n_classes)
         binned = trees_train.make_bins(jnp.asarray(state0.x), cfg.forest.max_bins)
         self._edges = binned.edges
+        # Bin-edge drift tracking: the binning is frozen at cold start until
+        # the ingested stream's out-of-range EMA crosses the refresh
+        # threshold (ServeConfig.bin_refresh_out_frac); the epoch versions
+        # every program set built against the edges.
+        self._edges_epoch = 0
+        self._oob_ema: Optional[float] = None
+        self._fresh_since_refresh = 0
+        self._set_edge_bounds()
         self._slab = slab_lib.init_slab_pool(
             state0.x, state0.oracle_y, state0.labeled_mask,
             self._edges, serve.slab_rows,
@@ -360,6 +377,116 @@ class Tenant:
         pad = self._slab.capacity - mask.shape[0]
         return jnp.pad(jnp.asarray(mask, bool), (0, pad))
 
+    def _set_edge_bounds(self) -> None:
+        """Host copies of the outermost quantile edges per feature — what
+        the ingest path's out-of-range check compares blocks against
+        without touching the device."""
+        e = np.asarray(self._edges)
+        self._edges_lo = e[:, 0]
+        self._edges_hi = e[:, -1]
+
+    @property
+    def forest_fingerprint(self) -> str:
+        """Identity of the resident forest's FEATURE SPACE: the bin edges +
+        their epoch. Scores are only comparable across queries while this
+        holds still; a drift-triggered bin refresh bumps it (the 'forest
+        fingerprint bump' consumers key cache invalidation on)."""
+        import hashlib
+
+        h = hashlib.sha256()
+        h.update(np.asarray(self._edges).tobytes())
+        h.update(str(self._edges_epoch).encode())
+        return h.hexdigest()[:16]
+
+    # -- drift-triggered bin-edge refresh ------------------------------------
+
+    def _observe_block_range(self, bx: np.ndarray, count: int) -> None:
+        """Fold one ingest block's out-of-cold-start-range fraction into the
+        EMA. In-distribution streams sit near 2/max_bins by construction
+        (the outermost quantile edges), far under the refresh threshold; a
+        mean-shifted/rotated stream climbs toward 1."""
+        if getattr(self.serve, "bin_refresh_out_frac", 0.0) <= 0.0 or count == 0:
+            return
+        real = bx[:count]
+        # The MOST-drifted feature's out-of-range fraction (not the mean
+        # over features — a one-axis mean shift would be diluted by d-1
+        # stationary features): in-distribution it sits near 2/max_bins,
+        # a drifted axis climbs toward 1.
+        oob = float(
+            np.max(
+                np.mean(
+                    (real < self._edges_lo) | (real > self._edges_hi), axis=0
+                )
+            )
+        )
+        if self._oob_ema is None:
+            self._oob_ema = oob
+        else:
+            self._oob_ema += 0.2 * (oob - self._oob_ema)
+        self._fresh_since_refresh += count
+
+    def _maybe_refresh_bins(self) -> None:
+        thr = getattr(self.serve, "bin_refresh_out_frac", 0.0)
+        if thr <= 0.0 or self._oob_ema is None:
+            return
+        if self._inflight is not None:
+            return  # the slab is donation-bound to a running chunk; defer
+        if self._fresh_since_refresh < self.serve.drift_min_fresh:
+            return
+        if self._oob_ema > thr:
+            self._refresh_bins()
+
+    def _refresh_bins(self) -> None:
+        """Re-quantile the bin edges from the LIVE slab and rebuild against
+        them — the serving half of the drift scenario (the cold-start
+        binning was documented as frozen until this landed).
+
+        The whole filled slab re-bins: edges from the current points'
+        quantiles, codes re-coded in one off-path launch, the per-capacity
+        program cache dropped (fit/chunk closures captured the old edges),
+        the forest re-fit, and the forest fingerprint bumped. The rebuilt
+        programs are FRESH instances, so their first compiles are warmup by
+        definition — ``recompiles_after_warmup`` stays 0 on the
+        non-drifting path AND across a refresh (pinned in
+        tests/test_scenarios.py); the one-off cost is tagged onto the next
+        query as the ``bin_refresh_compile`` latency cause instead.
+        """
+        from distributed_active_learning_tpu.ops import trees_train
+
+        fill = self._fill
+        x_host = np.asarray(self._slab.x)[:fill]
+        binned = trees_train.make_bins(
+            jnp.asarray(x_host), self.cfg.forest.max_bins
+        )
+        self._edges = binned.edges
+        self._edges_epoch += 1
+        self._set_edge_bounds()
+        # Re-code the whole slab against the new edges; rows past the
+        # watermark are unobservable junk either way (the slab contract).
+        self._slab = self._slab.replace(
+            codes=trees_train.code_features(self._slab.x, self._edges)
+        )
+        with self._programs_lock:
+            self._programs = {}
+        self.stats.bin_refreshes += 1
+        self._oob_ema = None
+        self._fresh_since_refresh = 0
+        self._latency_causes.add("bin_refresh_compile")
+        telemetry.flight_record(
+            "bin_refresh", tenant=self.tenant_id,
+            epoch=self._edges_epoch, fill=fill,
+            capacity=self._slab.capacity,
+        )
+        if self.metrics is not None:
+            self.metrics.event(
+                "bin_refresh", tenant=self.tenant_id,
+                epoch=self._edges_epoch, fill=fill,
+                capacity=self._slab.capacity,
+                forest_fingerprint=self.forest_fingerprint,
+            )
+        self._refresh_forest()
+        self._schedule_precompile()
+
     def _chunk_signature(self) -> tuple:
         """The program-shape identity a tenant-axis batched re-fit groups on:
         tenants whose chunks would trace to the same per-cell body (strategy,
@@ -406,6 +533,9 @@ class Tenant:
             make_device_fit,
         )
 
+        # One coherent (edges, epoch) read: a bin refresh racing this build
+        # bumps the epoch, and _install_programs rejects the stale set.
+        edges_epoch = self._edges_epoch
         fit = make_device_fit(self.cfg, self._edges, self._fit_budget, self.n_classes)
         chunk = make_chunk_fn(
             self._strategy,
@@ -466,6 +596,7 @@ class Tenant:
             chunk_tracker=_ProgramTracker(m, f"serve_chunk@{tid}@{capacity}", chunk),
             fit_tracker=_ProgramTracker(m, f"serve_fit@{tid}@{capacity}", fit),
             aot=aot,
+            edges_epoch=edges_epoch,
         )
 
     def _programs_for(self, capacity: int) -> _CapacityPrograms:
@@ -480,6 +611,10 @@ class Tenant:
 
     def _install_programs(self, capacity: int, progs: _CapacityPrograms) -> bool:
         with self._programs_lock:
+            if progs.edges_epoch != self._edges_epoch:
+                # built against pre-refresh bin edges: installing it would
+                # silently serve a forest fit on the stale feature coding
+                return False
             if capacity in self._programs:
                 return False
             self._programs[capacity] = progs
@@ -545,6 +680,8 @@ class Tenant:
         # refit dispatch (both can be pending; the compile is the spike).
         if "slab_growth_compile" in self._latency_causes:
             cause = "slab_growth_compile"
+        elif "bin_refresh_compile" in self._latency_causes:
+            cause = "bin_refresh_compile"
         elif "refit_dispatch" in self._latency_causes or self._inflight is not None:
             cause = "refit_dispatch"
         else:
@@ -625,6 +762,8 @@ class Tenant:
         self.stats.ingest_blocks += 1
         self.stats.ingested_points += count
         self.drift.observe_ingest(count)
+        self._observe_block_range(bx, count)
+        self._maybe_refresh_bins()
         if self.metrics is not None:
             self.metrics.event(
                 "ingest", tenant=self.tenant_id,
@@ -923,6 +1062,9 @@ class Tenant:
             "refits_skipped_fit_budget": self.stats.refits_skipped_fit_budget,
             "slab_growths": self.stats.slab_growths,
             "growths_precompiled": self.stats.growths_precompiled,
+            "bin_refreshes": self.stats.bin_refreshes,
+            "bin_epoch": self._edges_epoch,
+            "forest_fingerprint": self.forest_fingerprint,
             "capacity": self._slab.capacity,
             "fill": self._fill,
             "labeled": self._labeled,
@@ -1096,6 +1238,24 @@ class TenantManager:
             raise ValueError(
                 f"tenant id {tenant_id!r} must match {_TENANT_ID_RE.pattern} "
                 "(it names checkpoint files and telemetry streams)"
+            )
+        # SLO classes (serving/frontend.py): a non-positive weight would
+        # starve the tenant FOREVER under deficit round-robin (its credits
+        # never reach a slot's cost and its Futures never resolve) — refuse
+        # at residency time, where the operator can see it, not in the
+        # shared dispatcher loop.
+        if getattr(serve, "slo_weight", 1.0) <= 0.0:
+            raise ValueError(
+                f"tenant {tenant_id!r} has slo_weight="
+                f"{serve.slo_weight}; weights must be > 0 (1.0 = served "
+                "every contended cycle, 0.5 = every other one) — to pause "
+                "a tenant, stop submitting to it"
+            )
+        if getattr(serve, "slo_priority", 0) < 0:
+            raise ValueError(
+                f"tenant {tenant_id!r} has slo_priority="
+                f"{serve.slo_priority}; priorities are >= 0 (admission cap "
+                "scales by 1 + priority)"
             )
         with self._lock:
             if tenant_id in self._tenants:

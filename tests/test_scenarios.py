@@ -1,0 +1,666 @@
+"""Scenario engine (scenarios/ + the PR's cross-layer wiring): parity,
+exactness, accounting, serving drift refresh, frontend SLO classes.
+
+The pins, in the ISSUE's words:
+
+- a grid with scenario=none is BIT-IDENTICAL to pre-PR launches (records and
+  checkpoint fingerprints unchanged; the fingerprint only widens when a
+  scenario is active, mirroring the quantize="none" convention);
+- each scenario's grid cells are bit-identical to running that scenario
+  serially;
+- noisy-oracle budget accounting counts REVEALED labels — an all-abstain
+  oracle never terminates a cell early;
+- knapsack selection is exact against a host greedy reference (tie-breaks
+  included), alongside merge_tile_topk's exactness suite;
+- the serving bin-edge refresh fires under a synthetic drift stream with a
+  forest-fingerprint bump and ZERO post-warmup recompiles on the
+  non-drifting path;
+- the `scenario` registry kind is live in the auditor (donation +
+  carry-aval rules fire on seeded violations of the noisy-reveal and
+  knapsack-select program shapes).
+
+Shapes are tiny (96-row pools, 4-tree forests) — grid compiles dominate
+tier-1 cost, so the scenario matrix runs once per module fixture.
+"""
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_active_learning_tpu.config import (
+    DataConfig,
+    ExperimentConfig,
+    ForestConfig,
+    ScenarioConfig,
+    StrategyConfig,
+)
+from distributed_active_learning_tpu.runtime.loop import run_experiment
+from distributed_active_learning_tpu.runtime.sweep import run_grid
+
+SCENARIOS = [
+    ScenarioConfig(),
+    ScenarioConfig(kind="noisy_oracle", flip_prob=0.2, abstain_prob=0.3),
+    ScenarioConfig(kind="rare_event", rare_class=1),
+    ScenarioConfig(kind="drift", drift_rate=0.3),
+    ScenarioConfig(kind="cost_budget", cost_budget=6.0),
+]
+
+
+def _cfg(**kw):
+    return ExperimentConfig(
+        data=kw.pop("data", DataConfig(name="checkerboard2x2", n_samples=96, seed=2)),
+        forest=kw.pop(
+            "forest",
+            ForestConfig(n_trees=4, max_depth=3, fit="device", fit_budget=96),
+        ),
+        strategy=kw.pop("strategy", StrategyConfig(name="entropy", window_size=8)),
+        n_start=8,
+        max_rounds=kw.pop("max_rounds", 3),
+        seed=kw.pop("seed", 0),
+        rounds_per_launch=kw.pop("rounds_per_launch", 2),
+        log_every=0,
+        **kw,
+    )
+
+
+@pytest.fixture(scope="module")
+def scenario_grid():
+    """The headline table — every scenario family x 2 strategies x 2 seeds
+    as ONE launch stream, metrics riding the batched scan. Run once; the
+    parity/metrics/accounting tests all consume it."""
+    cfg = _cfg(collect_metrics=True)
+    return cfg, run_grid(
+        cfg, ["entropy", "density"], [0, 1], scenarios=SCENARIOS
+    )
+
+
+# ---------------------------------------------------------------------------
+# scenario-disabled parity: `none` IS the clean grid
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow  # the direct grid-vs-grid spelling of the pin; tier-1
+# keeps the transitive form — the mixed grid's none cells match serial clean
+# runs (the subset parity test below), and serial==grid is pinned by
+# test_grid — plus the all-none routing check, so the clean program identity
+# never regresses silently
+def test_scenario_none_grid_bit_identical_to_clean_grid():
+    cfg = _cfg(collect_metrics=True, max_rounds=2)
+    clean = run_grid(cfg, ["entropy"], [0, 1])
+    none = run_grid(cfg, ["entropy"], [0, 1], scenarios=[ScenarioConfig()])
+    assert not clean.serial_fallback and not none.serial_fallback
+    for c0, c1 in zip(clean.cells, none.cells):
+        a = [(r.round, r.n_labeled, r.accuracy, r.metrics) for r in c0.result.records]
+        b = [(r.round, r.n_labeled, r.accuracy, r.metrics) for r in c1.result.records]
+        assert a == b, (c0.strategy, c0.seed)
+
+
+def test_all_none_scenarios_route_to_the_clean_grid_path():
+    """`scenarios=[none]` must normalize to the scenario-free launcher (the
+    byte-identical pre-scenario program): the returned cells carry no
+    scenario axis artifacts and the chunk took the clean signature — pinned
+    cheaply here; the numeric grid-vs-grid twin is the slow variant below."""
+    cfg = _cfg(max_rounds=2)
+    grid = run_grid(cfg, ["entropy"], [0], scenarios=[ScenarioConfig()])
+    assert [c.scenario for c in grid.cells] == ["none"]
+    assert not grid.serial_fallback
+
+
+def test_fingerprints_widen_only_when_scenario_active():
+    from distributed_active_learning_tpu.runtime import checkpoint as ckpt_lib
+
+    cfg = _cfg()
+    # serial: an inactive scenario leaves the identity untouched (the
+    # quantize="none" convention — pre-scenario checkpoints keep resuming)
+    assert ckpt_lib.config_fingerprint(cfg) == ckpt_lib.config_fingerprint(
+        dataclasses.replace(cfg, scenario=ScenarioConfig())
+    )
+    noisy = dataclasses.replace(
+        cfg, scenario=ScenarioConfig(kind="noisy_oracle", flip_prob=0.1)
+    )
+    assert ckpt_lib.config_fingerprint(noisy) != ckpt_lib.config_fingerprint(cfg)
+    # grid: no scenarios argument == scenario-free fingerprint
+    base = ckpt_lib.grid_fingerprint(cfg, ["entropy"], [0, 1], ["d"], [8])
+    assert base == ckpt_lib.grid_fingerprint(
+        cfg, ["entropy"], [0, 1], ["d"], [8], scenarios=None
+    )
+    assert base != ckpt_lib.grid_fingerprint(
+        cfg, ["entropy"], [0, 1], ["d"], [8], scenarios=["noisy_oracle"]
+    )
+
+
+# ---------------------------------------------------------------------------
+# grid-vs-serial parity per scenario
+# ---------------------------------------------------------------------------
+
+
+def _assert_cell_matches_serial(cfg, cell, by_kind):
+    serial = run_experiment(
+        dataclasses.replace(
+            cfg,
+            seed=cell.seed,
+            strategy=dataclasses.replace(cfg.strategy, name=cell.strategy),
+            scenario=by_kind[cell.scenario],
+            rounds_per_launch=1,  # the per-round driver is the reference
+        )
+    )
+    got = [(r.round, r.n_labeled, r.accuracy) for r in cell.result.records]
+    want = [(r.round, r.n_labeled, r.accuracy) for r in serial.records]
+    assert got == want, (cell.strategy, cell.scenario, cell.seed)
+    for gm, sm in zip(cell.result.records, serial.records):
+        assert gm.metrics == sm.metrics, (cell.strategy, cell.scenario)
+
+
+def test_scenario_cells_bit_identical_to_serial_runs(scenario_grid):
+    """One serial twin per SCENARIO family (entropy, seed 0) — the per-family
+    parity pin at tier-1 cost; the full 20-cell matrix runs as the slow
+    variant below."""
+    cfg, grid = scenario_grid
+    assert not grid.serial_fallback
+    assert len(grid.cells) == len(SCENARIOS) * 2 * 2
+    by_kind = {s.kind: s for s in SCENARIOS}
+    # none (the flip-all-False clean body inside the scenario spelling) plus
+    # the three ROUND-BODY-changing families; rare_event's body is the clean
+    # round + a metric, pinned by the metric tests below and the slow matrix
+    for kind in ("none", "noisy_oracle", "cost_budget", "drift"):
+        cell = grid.cell("entropy", "checkerboard2x2", 0, scenario=kind)
+        _assert_cell_matches_serial(cfg, cell, by_kind)
+
+
+@pytest.mark.slow  # the full scenario x strategy x seed matrix (20 serial twins)
+def test_scenario_cells_bit_identical_full_matrix(scenario_grid):
+    cfg, grid = scenario_grid
+    by_kind = {s.kind: s for s in SCENARIOS}
+    for cell in grid.cells:
+        _assert_cell_matches_serial(cfg, cell, by_kind)
+
+
+def test_scenario_grid_one_compile_for_the_matrix(scenario_grid):
+    _cfg_, grid = scenario_grid
+    assert grid.launches >= 2
+    assert grid.recompiles_after_warmup == 0
+
+
+def test_scenario_metric_keys_scoped_per_cell(scenario_grid):
+    _cfg_, grid = scenario_grid
+    none_cell = grid.cell("entropy", "checkerboard2x2", 0, scenario="none")
+    assert "rare_recall" not in none_cell.result.records[0].metrics
+    assert "cost_spent" not in none_cell.result.records[0].metrics
+    rare = grid.cell("entropy", "checkerboard2x2", 0, scenario="rare_event")
+    rr = [r.metrics["rare_recall"] for r in rare.result.records]
+    assert all(0.0 <= v <= 1.0 for v in rr)
+    assert rr == sorted(rr)  # recall is monotone in revealed labels
+    cost = grid.cell("density", "checkerboard2x2", 1, scenario="cost_budget")
+    spends = [r.metrics["cost_spent"] for r in cost.result.records]
+    assert all(0.0 < s <= 6.0 + 1e-5 for s in spends)  # the per-round cap
+
+
+def test_rare_recall_matches_host_reference(scenario_grid):
+    """The in-scan recall-at-budget equals a host recount from the pool."""
+    from distributed_active_learning_tpu.data.datasets import get_dataset
+
+    cfg, grid = scenario_grid
+    bundle = get_dataset(cfg.data)
+    y = np.asarray(bundle.train_y)
+    total_rare = int((y == 1).sum())
+    cell = grid.cell("entropy", "checkerboard2x2", 0, scenario="rare_event")
+    # labels revealed by the last round <= n_start + rounds*window; recompute
+    # the bound only — exact recount needs the mask, which the in-scan metric
+    # already reduces — so pin the final value against found/total bounds.
+    final = cell.result.records[-1]
+    assert final.metrics["rare_recall"] <= final.n_labeled / max(total_rare, 1) + 1e-6
+
+
+# ---------------------------------------------------------------------------
+# noisy oracle: revealed-label accounting
+# ---------------------------------------------------------------------------
+
+
+def test_all_abstain_oracle_never_terminates_early():
+    """abstain_prob=1.0: every pick is refused, the labeled count never
+    moves, and the run still executes its FULL round quota — the stop
+    scalars count revealed labels, never picks."""
+    cfg = _cfg(
+        max_rounds=4,
+        scenario=ScenarioConfig(kind="noisy_oracle", abstain_prob=1.0),
+    )
+    res = run_experiment(cfg)
+    assert [r.round for r in res.records] == [1, 2, 3, 4]
+    assert all(r.n_labeled == cfg.n_start for r in res.records)
+
+
+def test_abstaining_oracle_requires_max_rounds():
+    cfg = _cfg(
+        max_rounds=None,
+        scenario=ScenarioConfig(kind="noisy_oracle", abstain_prob=0.5),
+    )
+    with pytest.raises(ValueError, match="max_rounds"):
+        run_experiment(cfg)
+
+
+def test_noisy_reveal_counts_revealed_not_picked():
+    cfg = _cfg(
+        max_rounds=3,
+        scenario=ScenarioConfig(kind="noisy_oracle", abstain_prob=0.5),
+    )
+    res = run_experiment(cfg)
+    gains = np.diff([cfg.n_start] + [r.n_labeled for r in res.records])
+    # picks are window-sized (8); with abstention every round reveals
+    # somewhere in [0, window] — and (seeded) strictly fewer in total
+    assert all(0 <= g <= 8 for g in gains)
+    assert sum(gains) < 3 * 8
+
+
+# ---------------------------------------------------------------------------
+# knapsack selection kernel: exact vs host reference
+# ---------------------------------------------------------------------------
+
+
+def _host_knapsack(scores, costs, mask, k, budget):
+    scores, costs, mask = map(np.asarray, (scores, costs, mask))
+    avail = mask.copy()
+    remaining = float(budget)
+    out = []
+    for _ in range(k):
+        cand = avail & (costs <= remaining)
+        if not cand.any():
+            out.append(None)
+            continue
+        ratio = np.where(cand, scores / costs, -np.inf)
+        i = int(np.argmax(ratio))  # ties -> lowest index, like jnp.argmax
+        avail[i] = False
+        remaining -= float(costs[i])
+        out.append(i)
+    return out, float(budget) - remaining
+
+
+def test_knapsack_top_k_exact_against_host_reference():
+    from distributed_active_learning_tpu.ops.topk import knapsack_top_k
+
+    rng = np.random.default_rng(7)
+    for trial in range(5):
+        n, k, budget = 64, 10, 12.0
+        scores = rng.uniform(0.0, 1.0, n).astype(np.float32)
+        costs = rng.uniform(1.0, 5.0, n).astype(np.float32)
+        mask = rng.uniform(size=n) < 0.7
+        vals, idx, keep, spent = jax.jit(
+            functools.partial(knapsack_top_k, k=k, budget=budget)
+        )(jnp.asarray(scores), jnp.asarray(costs), jnp.asarray(mask))
+        want, want_spent = _host_knapsack(scores, costs, mask, k, budget)
+        got = [int(i) if bool(kp) else None for i, kp in zip(idx, keep)]
+        assert got == want, trial
+        assert np.isclose(float(spent), want_spent, atol=1e-5), trial
+        assert float(spent) <= budget + 1e-5
+
+
+@pytest.mark.slow  # one extra grid compile; the review-found accounting pin
+def test_cost_spend_matches_serial_under_heterogeneous_windows():
+    """A narrower cell inside a padded-window grid must report the SAME
+    per-round spend as its serial twin: the knapsack runs at the pad width,
+    but picks masked out by the cell's own window are never revealed and
+    must not consume reported budget (spend is recomputed from the final
+    kept picks inside the round core — one formula for both drivers)."""
+    cfg = _cfg(
+        collect_metrics=True, max_rounds=2,
+        scenario=ScenarioConfig(kind="cost_budget", cost_budget=9.0),
+    )
+    grid = run_grid(
+        cfg, ["entropy", "density"], [0], windows=[4, 8],
+        scenarios=[ScenarioConfig(kind="cost_budget", cost_budget=9.0)],
+    )
+    assert not grid.serial_fallback
+    for cell in grid.cells:
+        serial = run_experiment(
+            dataclasses.replace(
+                cfg,
+                seed=cell.seed,
+                strategy=dataclasses.replace(
+                    cfg.strategy, name=cell.strategy, window_size=cell.window
+                ),
+                rounds_per_launch=1,
+            )
+        )
+        got = [
+            (r.n_labeled, r.metrics["cost_spent"]) for r in cell.result.records
+        ]
+        want = [(r.n_labeled, r.metrics["cost_spent"]) for r in serial.records]
+        assert got == want, (cell.strategy, cell.window)
+
+
+def test_tenant_refuses_nonpositive_slo_weight():
+    """A zero/negative weight would starve the tenant forever under deficit
+    round-robin (its Futures never resolve) — refused at residency time."""
+    from distributed_active_learning_tpu.config import ServeConfig
+    from distributed_active_learning_tpu.serving.tenants import TenantManager
+
+    x = np.asarray(jax.random.normal(jax.random.key(0), (64, 4)), np.float32)
+    y = (x[:, 0] > 0).astype(np.int32)
+    cfg = ExperimentConfig(
+        forest=ForestConfig(n_trees=4, max_depth=3, fit="device", fit_budget=64),
+        strategy=StrategyConfig(name="uncertainty", window_size=8),
+        n_start=8, log_every=0,
+    )
+    mgr = TenantManager()
+    with pytest.raises(ValueError, match="slo_weight"):
+        mgr.add_tenant(
+            "t", cfg, ServeConfig(slab_rows=128, slo_weight=0.0), x, y, x, y
+        )
+    with pytest.raises(ValueError, match="slo_priority"):
+        mgr.add_tenant(
+            "t", cfg, ServeConfig(slab_rows=128, slo_priority=-1), x, y, x, y
+        )
+
+
+def test_knapsack_tie_break_lowest_index():
+    from distributed_active_learning_tpu.ops.topk import knapsack_top_k
+
+    # identical ratios everywhere: greedy must take ascending pool indices
+    scores = jnp.ones(8, jnp.float32)
+    costs = jnp.ones(8, jnp.float32)
+    mask = jnp.ones(8, bool)
+    _, idx, keep, spent = knapsack_top_k(scores, costs, mask, 4, 3.0)
+    assert [int(i) for i in idx[:3]] == [0, 1, 2]
+    assert [bool(b) for b in keep] == [True, True, True, False]  # budget 3
+    assert float(spent) == 3.0
+
+
+# ---------------------------------------------------------------------------
+# auditor: the scenario registry kind is live
+# ---------------------------------------------------------------------------
+
+
+def test_scenario_registry_kind_audits_clean():
+    from distributed_active_learning_tpu.analysis import build_registry, run_audit
+
+    report = run_audit(build_registry(kinds=["scenario"], placements=["cpu"]))
+    assert sorted(report.programs) == [
+        "scenario/cost_chunk/cpu",
+        "scenario/drift_chunk/cpu",
+        "scenario/knapsack_select/cpu",
+        "scenario/noisy_chunk/cpu",
+        "scenario/rare_chunk/cpu",
+    ]
+    assert report.findings == [], [str(f) for f in report.findings]
+
+
+def test_scenario_donation_rule_fires_on_undonated_noisy_chunk():
+    """Seeded violation: a noisy-reveal chunk whose builder dropped
+    donate_argnums while the spec still promises donation — the `scenario`
+    kind's programs run the donation rule for real."""
+    from distributed_active_learning_tpu.analysis import programs as prog
+    from distributed_active_learning_tpu.analysis.auditor import AuditUnit, audit_unit
+    from distributed_active_learning_tpu.runtime.loop import make_chunk_fn
+
+    unit = prog._build_scenario("noisy_chunk", "cpu")
+    strategy, _aux = prog._strategy_and_aux("uncertainty")
+    undonated = make_chunk_fn(
+        strategy, prog.WINDOW, prog.CHUNK_ROUNDS, prog._device_fit("gemm"),
+        prog.LABEL_CAP, with_metrics=True, n_classes=2,
+        scenario=prog._scenario_audit_cfg("noisy_chunk"),
+        donate=False,
+    )
+    planted = AuditUnit(
+        name="fixture/scenario-no-donation", fn=undonated, args=unit.args,
+        expect_donation=True, with_metrics=True,
+        carry_in_argnums=(1,), carry_out_index=0,
+    )
+    fired = {f.rule for f in audit_unit(planted)}
+    assert "donation-not-aliased" in fired
+
+
+def test_scenario_carry_rule_fires_on_drifting_knapsack_select():
+    """Seeded violation: a knapsack-select program whose 'carry' (the
+    selection mask) comes back at a drifted dtype — carry-aval-drift is
+    live on the scenario kind's program shapes."""
+    from distributed_active_learning_tpu.analysis.auditor import AuditUnit, audit_unit
+    from distributed_active_learning_tpu.ops.topk import knapsack_top_k
+
+    @jax.jit
+    def bad_select(mask, scores, costs):
+        _vals, idx, keep, _spent = knapsack_top_k(scores, costs, mask, 5, 8.0)
+        new_mask = mask.at[idx].min(~keep)
+        return new_mask.astype(jnp.int8), idx  # carry drifts bool -> int8
+
+    unit = AuditUnit(
+        name="fixture/knapsack-carry-drift", fn=bad_select,
+        args=(
+            jax.ShapeDtypeStruct((64,), jnp.bool_),
+            jax.ShapeDtypeStruct((64,), jnp.float32),
+            jax.ShapeDtypeStruct((64,), jnp.float32),
+        ),
+        carry_in_argnums=(0,), carry_out_index=0,
+    )
+    fired = {f.rule for f in audit_unit(unit)}
+    assert "carry-aval-drift" in fired
+
+
+def test_specs_for_experiment_routes_scenario_runs():
+    from distributed_active_learning_tpu.analysis import specs_for_experiment
+
+    cfg = _cfg(scenario=ScenarioConfig(kind="cost_budget", cost_budget=4.0))
+    specs = specs_for_experiment(cfg)
+    assert [s.name for s in specs] == ["scenario/cost_chunk/cpu"]
+
+
+# ---------------------------------------------------------------------------
+# serving: drift-triggered bin-edge refresh + SLO classes
+# ---------------------------------------------------------------------------
+
+
+def _serve_setup(bin_refresh_out_frac=0.35):
+    from distributed_active_learning_tpu.config import ServeConfig
+    from distributed_active_learning_tpu.serving.service import ALService
+
+    key = jax.random.key(0)
+    from distributed_active_learning_tpu.data import synthetic
+
+    blocks = synthetic.make_drifting_stream(
+        key, n_blocks=5, block_rows=64, d=4, rate=3.0, warm_blocks=1
+    )
+    x0, y0 = np.asarray(blocks[0][0]), np.asarray(blocks[0][1])
+    cfg = ExperimentConfig(
+        forest=ForestConfig(n_trees=4, max_depth=3, fit="device", fit_budget=128),
+        strategy=StrategyConfig(name="uncertainty", window_size=8),
+        n_start=8, log_every=0,
+    )
+    serve = ServeConfig(
+        slab_rows=256, ingest_block=64, score_width=32,
+        drift_min_fresh=64, max_staleness=0,
+        bin_refresh_out_frac=bin_refresh_out_frac,
+    )
+    return ALService(cfg, serve, x0, y0, x0, y0), blocks
+
+
+def test_bin_edge_refresh_fires_under_drift_with_fingerprint_bump():
+    svc, blocks = _serve_setup()
+    t = svc._tenant
+    fp0 = t.forest_fingerprint
+    for bx, by in blocks[1:]:
+        svc.submit(np.asarray(bx), np.asarray(by))
+        svc.score(np.asarray(bx[:8]))
+    assert t.stats.bin_refreshes >= 1
+    assert t._edges_epoch == t.stats.bin_refreshes
+    assert t.forest_fingerprint != fp0
+    # the refresh rebuilds FRESH program instances: their first compiles are
+    # warmup, so the no-silent-recompile contract holds across a refresh
+    assert t.recompiles_after_warmup() == 0
+    # the service still scores after re-binning
+    assert svc.score(np.asarray(blocks[-1][0][:4])).shape == (4,)
+
+
+@pytest.mark.slow  # the frozen-edges control; the refresh-path test above
+# already pins recompiles == 0, and the DEFAULT config disables the refresh
+# entirely (every pre-existing serve test runs the untouched path)
+def test_bin_edge_refresh_quiet_on_stationary_stream():
+    from distributed_active_learning_tpu.data import synthetic
+
+    svc, _ = _serve_setup()
+    t = svc._tenant
+    fp0 = t.forest_fingerprint
+    blocks = synthetic.make_drifting_stream(
+        jax.random.key(1), n_blocks=6, block_rows=64, d=4, rate=0.0
+    )
+    for bx, by in blocks:
+        svc.submit(np.asarray(bx), np.asarray(by))
+        svc.score(np.asarray(bx[:8]))
+    assert t.stats.bin_refreshes == 0
+    assert t.forest_fingerprint == fp0
+    assert t.recompiles_after_warmup() == 0
+
+
+def test_frontend_slo_weighted_round_robin_and_priority_admission():
+    import collections
+    from concurrent.futures import Future
+
+    from distributed_active_learning_tpu.config import ServeConfig
+    from distributed_active_learning_tpu.serving.frontend import (
+        ServiceFrontend,
+        _Request,
+    )
+    from distributed_active_learning_tpu.serving.tenants import TenantManager
+
+    x = np.asarray(jax.random.normal(jax.random.key(0), (64, 4)), np.float32)
+    y = (x[:, 0] > 0).astype(np.int32)
+    cfg = ExperimentConfig(
+        forest=ForestConfig(n_trees=4, max_depth=3, fit="device", fit_budget=64),
+        strategy=StrategyConfig(name="uncertainty", window_size=8),
+        n_start=8, log_every=0,
+    )
+    gold = ServeConfig(
+        slab_rows=128, score_width=8, max_pending=4,
+        slo_weight=1.0, slo_priority=1,
+    )
+    std = ServeConfig(
+        slab_rows=128, score_width=8, max_pending=4,
+        slo_weight=0.5, slo_priority=0,
+    )
+    mgr = TenantManager()
+    mgr.add_tenant("gold", cfg, gold, x, y, x, y)
+    mgr.add_tenant("std", cfg, std, x, y, x, y)
+    fe = ServiceFrontend(mgr)
+    fe._running = True  # drive _collect cycles directly — deterministic
+    for _ in range(12):
+        for tid in ("gold", "std"):
+            q = fe._queues.setdefault(tid, collections.deque())
+            while len(q) < 3:
+                q.append(_Request("score", tid, x[:4], None, Future(), 0.0))
+        fe._collect()
+    # weight 1.0 -> every contended cycle; weight 0.5 -> every other one
+    assert fe.slo_served["gold"] == 12
+    assert fe.slo_served["std"] == 6
+    assert fe.slo_deferred["std"] == 6
+    assert "gold" not in fe.slo_deferred
+    # priority admission: gold's effective queue cap doubles
+    assert fe._cap_for("gold") == 8
+    assert fe._cap_for("std") == 4
+
+
+@pytest.mark.slow  # back-compat control: the default weights reduce to the
+# pre-SLO rotation (also exercised by every test_serving_multi frontend test)
+def test_frontend_default_slo_is_the_fair_rotation():
+    """slo_weight 1.0 / priority 0 (the defaults) reproduce the pre-SLO
+    dispatcher exactly: every tenant served every cycle, base caps."""
+    import collections
+    from concurrent.futures import Future
+
+    from distributed_active_learning_tpu.config import ServeConfig
+    from distributed_active_learning_tpu.serving.frontend import (
+        ServiceFrontend,
+        _Request,
+    )
+    from distributed_active_learning_tpu.serving.tenants import TenantManager
+
+    x = np.asarray(jax.random.normal(jax.random.key(0), (64, 4)), np.float32)
+    y = (x[:, 0] > 0).astype(np.int32)
+    cfg = ExperimentConfig(
+        forest=ForestConfig(n_trees=4, max_depth=3, fit="device", fit_budget=64),
+        strategy=StrategyConfig(name="uncertainty", window_size=8),
+        n_start=8, log_every=0,
+    )
+    serve = ServeConfig(slab_rows=128, score_width=8, max_pending=4)
+    mgr = TenantManager()
+    mgr.add_tenant("a", cfg, serve, x, y, x, y)
+    mgr.add_tenant("b", cfg, serve, x, y, x, y)
+    fe = ServiceFrontend(mgr)
+    fe._running = True
+    for _ in range(5):
+        for tid in ("a", "b"):
+            q = fe._queues.setdefault(tid, collections.deque())
+            q.append(_Request("score", tid, x[:4], None, Future(), 0.0))
+        scores, _ingests, _held = fe._collect()
+        assert set(scores) == {"a", "b"}
+    assert fe.slo_served == {"a": 5, "b": 5}
+    assert fe.slo_deferred == {}
+    assert fe._cap_for("a") == 4
+
+
+# ---------------------------------------------------------------------------
+# summarize_metrics: recall-at-budget + cost-spend tables
+# ---------------------------------------------------------------------------
+
+
+def test_summarize_scenario_tables_and_malformed_skips():
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "summarize_metrics",
+        os.path.join(
+            os.path.dirname(__file__), "..", "benches", "summarize_metrics.py"
+        ),
+    )
+    sm = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(sm)
+
+    events = [
+        {"kind": "round", "strategy": "entropy", "dataset": "fraud",
+         "seed": 0, "round": 1, "n_labeled": 10, "accuracy": 0.7,
+         "rare_recall": 0.25, "ts": 1.0},
+        {"kind": "round", "strategy": "entropy", "dataset": "fraud",
+         "seed": 0, "round": 2, "n_labeled": 18, "accuracy": 0.8,
+         "rare_recall": 0.5, "ts": 2.0},
+        {"kind": "round", "strategy": "entropy", "dataset": "fraud",
+         "seed": 0, "round": 3, "n_labeled": 20, "accuracy": 0.8,
+         "cost_spent": 5.5, "ts": 3.0},
+        # malformed: bool-typed / non-numeric / missing values must be
+        # SKIPPED, never crash (the serve-latency table conventions)
+        {"kind": "round", "strategy": "entropy", "rare_recall": True},
+        {"kind": "round", "strategy": "entropy", "rare_recall": "oops"},
+        {"kind": "round", "cost_spent": None},
+    ]
+    text = sm.summarize(events)
+    assert "== recall-at-budget ==" in text
+    assert "50.0" in text  # the final round's recall, in percent
+    assert "== cost spend ==" in text
+    assert "5.50" in text
+    # no scenario keys -> no scenario tables
+    text2 = sm.summarize([
+        {"kind": "round", "strategy": "s", "seed": 0, "round": 1,
+         "n_labeled": 5, "accuracy": 0.5, "ts": 1.0},
+    ])
+    assert "recall-at-budget" not in text2
+    assert "cost spend" not in text2
+
+
+# ---------------------------------------------------------------------------
+# CLI routing
+# ---------------------------------------------------------------------------
+
+
+def test_cli_scenario_refusals():
+    from distributed_active_learning_tpu.run import main
+
+    with pytest.raises(SystemExit):
+        main(["--scenario", "noisy_oracle", "--abstain-prob", "0.5",
+              "--neural", "--strategy", "deep.entropy"])
+    with pytest.raises(SystemExit):
+        main(["--scenario", "drift", "--drift-rate", "0.1",
+              "--fit", "device", "--fused-round"])
+    with pytest.raises(SystemExit):
+        main(["--scenario", "drift", "--drift-rate", "0.1"])  # host fit
+    with pytest.raises(SystemExit):
+        main(["--scenarios", "none,bogus", "--fit", "device"])
