@@ -1350,10 +1350,23 @@ def bench_serve_multi(args):
     post-warmup ``serve_latency`` event caused by ``slab_growth_compile``)
     on top of the usual ``recompiles_after_warmup == 0``. Per-tenant
     p50/p99 ride the payload so a noisy-neighbor tenant is attributable.
+
+    Live ops plane (PR 15): the bench serves ``/metrics``/``/healthz`` on
+    ``--ops-port`` (ephemeral when unset) for the WHOLE run and scrapes
+    itself from a sidecar thread while the client threads contend —
+    ``ops_scrapes`` proves the pull path works mid-flight, and the tier-1
+    job curls the same port externally. Tenants run under a deliberately
+    loose SLO (objective 10s at target 0.95 — a plumbing proof on noisy CPU
+    smoke rigs, not a latency gate); ``slo_compliance`` is the aggregate
+    good/total ratio, hard-spec'd in compare_bench.py so a real latency
+    collapse (or broken accounting) fires the sentinel.
     """
     import threading
+    import urllib.request
 
     import jax  # noqa: F401  (backend must be up before building programs)
+
+    from distributed_active_learning_tpu.runtime.obs import OpsServer
 
     from distributed_active_learning_tpu.config import (
         ExperimentConfig,
@@ -1387,7 +1400,16 @@ def bench_serve_multi(args):
         precompile_ahead=True,
         precompile_headroom_slabs=1.0,
         max_pending=max(per_tenant_queries, 64),
+        # SLO plumbing proof: generous objective (smoke p99 sits ~3s under
+        # refit_dispatch causes), so compliance reads ~1.0 on a healthy rig
+        # and the hard compare_bench spec only fires on a real collapse.
+        slo_latency_ms=10_000.0,
+        slo_target=0.95,
     )
+
+    # The ops endpoint is up for the WHOLE bench (cold start included) —
+    # an external scraper (the tier-1 job's curl) may arrive any time.
+    ops_server = OpsServer(port=getattr(args, "ops_port", None) or 0).start()
 
     def make(n, shift=0.0, seed_off=0):
         r = np.random.default_rng(seed_off)
@@ -1454,6 +1476,25 @@ def bench_serve_multi(args):
     admission_rejections = [0]
     frontend = ServiceFrontend(manager)
 
+    # Self-scrape sidecar: pull /metrics + /healthz while the clients
+    # contend — the proof the ops plane answers MID-FLIGHT, not just at the
+    # end. A scrape only counts when both endpoints answered 200.
+    scrapes = [0]
+    stop_scrape = threading.Event()
+
+    def scraper():
+        base = f"http://127.0.0.1:{ops_server.port}"
+        while not stop_scrape.is_set():
+            try:
+                with urllib.request.urlopen(f"{base}/metrics", timeout=5) as r:
+                    r.read()
+                with urllib.request.urlopen(f"{base}/healthz", timeout=5) as r:
+                    r.read()
+                scrapes[0] += 1
+            except Exception:  # noqa: BLE001 — a missed scrape is a count, not a crash
+                pass
+            stop_scrape.wait(0.25)
+
     def client(tid):
         r = np.random.default_rng(1000 + tids.index(tid))
         stream_pos = serve.ingest_block
@@ -1479,6 +1520,8 @@ def bench_serve_multi(args):
             latencies[tid].append(time.perf_counter() - tq)
 
     t0 = time.perf_counter()
+    scrape_thread = threading.Thread(target=scraper, name="ops-scraper", daemon=True)
+    scrape_thread.start()
     with frontend:
         threads = [
             threading.Thread(target=client, args=(tid,), name=f"client-{tid}")
@@ -1488,6 +1531,8 @@ def bench_serve_multi(args):
             th.start()
         for th in threads:
             th.join()
+    stop_scrape.set()
+    scrape_thread.join(timeout=10)
     manager.flush()
     wall = time.perf_counter() - t0
     ingest_failed = sum(1 for f in ingest_futures if f.exception() is not None)
@@ -1504,6 +1549,19 @@ def bench_serve_multi(args):
     }
     total_queries = T * per_tenant_queries
     manager.close()
+    ops_server.stop()
+    slo = summary.get("slo") or {}
+    if slo.get("compliance") is None:
+        # Every tenant was configured with an SLO and served queries, so a
+        # missing ratio means the ACCOUNTING broke — refuse loudly here
+        # rather than emitting slo_compliance: null, which compare_bench
+        # would structurally file under "skipped" (one-sided keys must skip:
+        # other modes' payloads legitimately lack this key entirely).
+        raise RuntimeError(
+            "serve-multi SLO accounting produced no compliance ratio "
+            f"(slo summary: {slo!r}) despite configured objectives and "
+            f"{total_queries} served queries"
+        )
     return {
         "serve_multi_qps": round(total_queries / wall, 2),
         "serve_multi_tenants": T,
@@ -1539,6 +1597,16 @@ def bench_serve_multi(args):
             summary["post_warmup_growth_compile_events"],
         "serve_multi_admission_rejections": admission_rejections[0],
         "serve_multi_ingest_failures": ingest_failed,
+        # Live ops plane (PR 15): aggregate SLO compliance (hard-spec'd in
+        # compare_bench.py) + per-tenant ratios, and the mid-flight scrape
+        # count proving /metrics + /healthz answered while clients contended.
+        "slo_compliance": slo.get("compliance"),
+        "serve_multi_slo_per_tenant": {
+            tid: snap.get("compliance")
+            for tid, snap in slo.get("per_tenant", {}).items()
+        },
+        "ops_scrapes": scrapes[0],
+        "ops_port": ops_server.port,
         "serve_multi_tenant_summaries": {
             tid: {
                 k: summary["per_tenant"][tid][k]
@@ -2352,6 +2420,14 @@ def main():
         "SIGINT, unhandled crash, SIGUSR1, and deadline skips — a dead run "
         "(BENCH_r05: rc 124, parsed null) leaves a trace of what it was "
         "doing",
+    )
+    ap.add_argument(
+        "--ops-port", type=int, default=None, metavar="PORT",
+        help="serve-multi mode: bind the live ops plane (runtime/obs.py — "
+        "/metrics Prometheus text, /healthz, /varz, /flightz) on "
+        "localhost:PORT for the whole run so it can be scraped mid-flight; "
+        "absent = an ephemeral port (the bench's self-scrape sidecar uses "
+        "it either way and reports ops_scrapes)",
     )
     ap.add_argument(
         "--deadline", type=float, default=None,

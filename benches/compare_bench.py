@@ -117,6 +117,16 @@ DEFAULT_SPECS: List[MetricSpec] = [
         "serve_multi_growth_compile_events", "lower", 0.0, kind="counter",
         hard=True,
     ),
+    # live ops plane (PR 15): SLO compliance is an architectural ratio, not
+    # rig noise — the serve-multi smoke objective is deliberately generous
+    # (10s at target 0.95), so a >5% drop means queries stopped finishing:
+    # hard. (Accounting that produces NO ratio is refused inside
+    # bench_serve_multi itself — a null here would structurally land under
+    # "skipped", since one-sided keys must skip for other modes' payloads.)
+    # ops_scrapes only proves the pull path worked mid-flight; its rate
+    # scales with wall time, so the threshold is loose and soft.
+    MetricSpec("slo_compliance", "higher", 0.05, hard=True),
+    MetricSpec("ops_scrapes", "higher", 0.90),
     MetricSpec("chunk_jit_cache_entries", "lower", 0.0, kind="counter"),
     # the audit surface itself: a payload that audited FEWER programs than
     # its baseline means the registry silently shrank (a kind dropped, a

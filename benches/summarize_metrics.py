@@ -25,6 +25,11 @@ Sections:
 - **tenants** — per-tenant latency/throughput/ingest/re-fit attribution from
   the tenant-tagged serving events (serving/tenants.py): a noisy-neighbor
   tenant is nameable from one JSONL;
+- **slo** — per-tenant SLO compliance + multi-window burn rates from the
+  periodic ``slo`` events (ServeConfig.slo_latency_ms; runtime/obs.py
+  SLOTracker), cross-checked against the latency stream: a tenant that has
+  serve_latency events but NO configured SLO gets a loud note — unmonitored
+  traffic is the gap this table exists to name;
 - **roofline** — per-program cost attribution events (run.py --roofline):
   flops/bytes, achieved rates, MFU, bound verdict;
 - **counters / gauges** — host transfer bytes, device memory watermarks.
@@ -480,6 +485,54 @@ def summarize(events: List[dict]) -> str:
                  "ingested", "refits"],
                 rows,
             )
+        )
+
+    # SLO table (serving/tenants.py emits periodic `slo` events when
+    # ServeConfig.slo_latency_ms is set): the LAST event per tenant is its
+    # current lifetime compliance + windowed burn. Cross-checked against the
+    # latency stream — a tenant with serve_latency traffic but no SLO events
+    # is flying unmonitored, which deserves a loud note, not silence.
+    slo_events = [
+        e for e in events
+        if e.get("kind") == "slo" and "tenant" in e
+        and isinstance(e.get("compliance"), (int, float))
+        and not isinstance(e.get("compliance"), bool)
+    ]
+    slo_by_tenant: Dict[str, dict] = {}
+    for e in slo_events:
+        slo_by_tenant[str(e["tenant"])] = e  # stream order: last wins
+    if slo_by_tenant:
+        rows = []
+        for tid, e in sorted(slo_by_tenant.items()):
+            def _b(key):
+                v = _num(e, key)
+                return f"{v:.2f}" if v is not None else "-"
+
+            rows.append([
+                tid,
+                f"{_num(e, 'objective_ms'):.0f}" if _num(e, "objective_ms") is not None else "-",
+                f"{100 * e['target']:.1f}" if _num(e, "target") is not None else "-",
+                f"{100 * e['compliance']:.3f}",
+                f"{e.get('good', '-')}/{e.get('total', '-')}",
+                _b("burn_1m"), _b("burn_5m"), _b("burn_1h"),
+            ])
+        out.append(
+            "\n== slo ==\n"
+            + _table(
+                ["tenant", "objective ms", "target %", "compliance %",
+                 "good/total", "burn 1m", "burn 5m", "burn 1h"],
+                rows,
+            )
+        )
+    unmonitored = sorted(
+        {str(e["tenant"]) for e in serve_events if "tenant" in e}
+        - set(slo_by_tenant)
+    )
+    if unmonitored and (slo_by_tenant or serve_events):
+        out.append(
+            "\nNOTE: tenant(s) with serve_latency events but NO SLO "
+            f"configured: {', '.join(unmonitored)} — their latency is "
+            "unmonitored traffic (set ServeConfig.slo_latency_ms)"
         )
 
     rooflines = [e for e in events if e.get("kind") == "roofline"]

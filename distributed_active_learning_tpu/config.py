@@ -247,6 +247,23 @@ class ServeConfig:
     # opt-in, so services configured before it keep the frozen-edges
     # behavior and its jit-cache/latency profile byte-for-byte.
     bin_refresh_out_frac: float = 0.0
+    # Live ops plane (runtime/obs.py): TCP port for the pull-based metrics
+    # endpoint — /metrics (Prometheus text), /healthz (event-loop liveness +
+    # last-touchdown age), /varz (full JSON snapshot), /flightz (flight-
+    # recorder dump over HTTP). 0 (the default) = no listener; the CLI entry
+    # points (serving.__main__, bench.py --mode serve-multi, run.py) honor
+    # it / the --ops-port flag. The registry FEEDS are always on (cheap
+    # host-side ints, bounded histograms); the port only gates the scrape.
+    ops_port: int = 0
+    # Per-tenant SLO objective (runtime/obs.py SLOTracker): a query is GOOD
+    # when it succeeds AND answers within slo_latency_ms; the tracker keeps
+    # the lifetime compliance ratio good/total and multi-window (1m/5m/1h)
+    # burn rates bad_frac / (1 - slo_target) — the SRE-workbook alerting
+    # form, surfaced as /metrics gauges, `slo` JSONL events, the service
+    # summary, and the serve-multi bench's `slo_compliance` key. <= 0 (the
+    # default) disables SLO accounting entirely.
+    slo_latency_ms: float = 0.0
+    slo_target: float = 0.99
 
 
 @dataclasses.dataclass(frozen=True)

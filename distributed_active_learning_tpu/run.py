@@ -212,6 +212,15 @@ def build_parser() -> argparse.ArgumentParser:
         "(runtime/telemetry.py FlightRecorder)",
     )
     ap.add_argument(
+        "--ops-port", type=int, default=0, metavar="PORT",
+        help="serve the live ops plane (runtime/obs.py) on localhost:PORT "
+        "for the duration of the run — /metrics (Prometheus text: launch "
+        "counters, pipeline depth, grid progress/frozen-cell/ETA gauges), "
+        "/healthz (liveness + last-touchdown age), /varz, /flightz — so a "
+        "multi-hour grid launch is watchable mid-flight instead of only "
+        "post-hoc; 0 (default) = off",
+    )
+    ap.add_argument(
         "--phase-detail", action="store_true",
         help="force per-phase (train/round/eval) host wall splits; with "
         "--rounds-per-launch > 1 this disables scan fusion (phases cannot "
@@ -327,6 +336,19 @@ def main(argv=None) -> int:
         from distributed_active_learning_tpu.runtime import telemetry
 
         telemetry.install_flight_recorder(args.flight_recorder)
+
+    if args.ops_port:
+        # Bound before any compile so /healthz answers from second one; the
+        # serve thread is a daemon — it dies with the run, no teardown path
+        # needed across this function's many exits.
+        from distributed_active_learning_tpu.runtime.obs import OpsServer
+
+        ops_server = OpsServer(port=args.ops_port).start()
+        print(
+            f"# ops plane: http://127.0.0.1:{ops_server.port}/metrics "
+            "(/healthz /varz /flightz)",
+            file=sys.stderr, flush=True,
+        )
 
     # phase_detail defaults False since the telemetry PR: an enabled Debugger
     # no longer costs a fused run its scan fusion (per-round visibility comes

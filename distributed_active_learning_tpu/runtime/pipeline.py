@@ -58,7 +58,7 @@ from typing import Any, Callable, NamedTuple, Optional
 
 import jax
 
-from distributed_active_learning_tpu.runtime import telemetry
+from distributed_active_learning_tpu.runtime import obs, telemetry
 
 
 class ChunkExtras(NamedTuple):
@@ -311,6 +311,12 @@ def run_pipelined(
         # Kick off the async D2H copy of everything the touchdown will read.
         start_host_copy((extras, ys))
         inflight.append(_InFlight(next_index, extras, ys, state, t0))
+        # Live ops plane: the in-flight depth gauge is what a /metrics scrape
+        # of a long chunked run shows moving — the pipeline is alive and how
+        # deep its launch window currently sits.
+        obs.gauge(
+            "pipeline_inflight", "chunk launches currently in flight"
+        ).set(len(inflight))
         telemetry.flight_record(
             "dispatch", index=next_index, inflight=len(inflight), depth=depth,
         )
@@ -370,6 +376,19 @@ def run_pipelined(
         stats.launch_seconds += launch_wall
         stats.touchdown_seconds += td_wall
         stats.overlap_seconds += overlapped
+        # Live ops plane: a fresh pipeline_touchdown heartbeat is /healthz's
+        # proof the driver is completing work, not just dispatching; the
+        # hidden-fraction gauge is the pipelining win live instead of only
+        # in the bench payload.
+        obs.heartbeat("pipeline_touchdown")
+        obs.gauge(
+            "pipeline_inflight", "chunk launches currently in flight"
+        ).set(len(inflight))
+        obs.gauge(
+            "touchdown_hidden_ratio",
+            "fraction of host-touchdown wall hidden under device execution",
+        ).set(round(stats.touchdown_hidden_fraction, 6))
+        obs.counter("pipeline_chunks", "chunk touchdowns completed").inc()
         if on_launch is not None:
             on_launch(
                 seconds=launch_wall,

@@ -63,6 +63,7 @@ import numpy as np
 from flax import struct
 
 from distributed_active_learning_tpu.config import ExperimentConfig
+from distributed_active_learning_tpu.runtime import obs
 from distributed_active_learning_tpu.runtime import state as state_lib
 from distributed_active_learning_tpu.runtime.results import ExperimentResult
 from distributed_active_learning_tpu.strategies import Strategy, StrategyAux, get_strategy
@@ -1495,9 +1496,11 @@ def run_grid(
         for _d in range(D)
         for e in range(E)
     ])
-    windows_cell = jnp.asarray(
-        [w for w in windows for _ in range(D * E)], dtype=jnp.int32
-    )
+    # ONE host-side per-cell window expansion (strategy-major cell order) —
+    # the device input below and the ops-plane progress gauges both read it,
+    # so a future cell-layout change cannot skew one without the other.
+    windows_by_cell = [w for w in windows for _ in range(D * E)]
+    windows_cell = jnp.asarray(windows_by_cell, dtype=jnp.int32)
     caps_host = [
         n_valids_host[d] if cfg.label_budget is None
         else min(cfg.label_budget, n_valids_host[d])
@@ -1711,6 +1714,44 @@ def run_grid(
     grid_state = SweepState(labeled_mask=masks0, key=keys0, round=rounds0)
     snapshots = pipeline_lib.CarrySnapshots(ckpt_snapshot)
 
+    # Live ops plane (runtime/obs.py): grid progress gauges, so a multi-hour
+    # scenario x strategy x seed launch is finally watchable mid-flight — a
+    # /metrics scrape shows cells, completed cell-rounds, how many cells have
+    # frozen (hit their own budget/round cap while the stream runs to the
+    # slowest cell), and a remaining-wall estimate. Host-side ints only; the
+    # traced grid program is untouched. The ETA assumes window-per-round
+    # reveals, so an abstaining-oracle group reads as an underestimate —
+    # it is an estimate gauge, not a stop decision.
+    obs_cell_labeled = list(counts0)
+    obs_cell_rounds = [max(sr, 0) for sr in start_rounds]
+    obs.gauge("grid_cells", "cells in the running grid launch").set(C)
+
+    def _obs_grid_progress(total_active: int) -> None:
+        frozen = 0
+        rem_rounds = 0
+        for c in range(C):
+            w = windows_by_cell[c]
+            rem_budget = caps_host[c] - obs_cell_labeled[c]
+            r = -(-rem_budget // w) if (w > 0 and rem_budget > 0) else 0
+            if cfg.max_rounds is not None:
+                r = min(r, max(cfg.max_rounds - obs_cell_rounds[c], 0))
+            if r <= 0:
+                frozen += 1
+            rem_rounds = max(rem_rounds, r)
+        obs.counter(
+            "grid_cell_rounds", "active cell-rounds completed across the grid"
+        ).inc(total_active)
+        obs.gauge(
+            "grid_cells_frozen", "cells stopped while the grid stream runs on"
+        ).set(frozen)
+        steady = launches.steady_seconds_mean()
+        if steady is not None:
+            obs.gauge(
+                "grid_eta_seconds",
+                "estimated wall seconds until the slowest cell finishes",
+            ).set(round(-(-rem_rounds // K) * steady, 3))
+        obs.heartbeat("grid_touchdown")
+
     grid_tail = (flip_masks, costs_ds) if scenario_axis else ()
 
     def dispatch(gs, idx):
@@ -1762,6 +1803,8 @@ def run_grid(
             r_c = rounds_np[act, c]
             l_c = labeled_np[act, c]
             a_c = acc_np[act, c]
+            obs_cell_labeled[c] = int(l_c[-1])
+            obs_cell_rounds[c] += int(act.sum())
             n_pool_c = n_valids_host[(c // E) % D]
             cell.result.extend_from_arrays(
                 r_c, l_c, n_pool_c - l_c, a_c,
@@ -1794,6 +1837,7 @@ def run_grid(
                             f"labeled={int(nl)} accu={float(a) * 100:.2f}"
                         )
         ctl.note_round(last_round)
+        _obs_grid_progress(total_active)
         if metrics is not None:
             fetched = (
                 active_y.nbytes + rounds_y.nbytes + labeled_y.nbytes
@@ -1829,6 +1873,13 @@ def run_grid(
             may_dispatch=ctl.may_dispatch,
             on_veto=lambda idx: launches.veto(idx, ctl.veto_reason(idx)),
         )
+    # The grid is over: a scrape arriving after the stream must read zero
+    # remaining wall, not the last mid-flight estimate (pool-exhaustion
+    # stops are invisible to the budget arithmetic above).
+    obs.gauge(
+        "grid_eta_seconds",
+        "estimated wall seconds until the slowest cell finishes",
+    ).set(0.0)
 
     if cfg.results_path:
         for c in cells:

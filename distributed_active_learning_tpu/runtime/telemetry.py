@@ -56,6 +56,8 @@ import jax.numpy as jnp
 import numpy as np
 from flax import struct
 
+from distributed_active_learning_tpu.runtime import obs
+
 
 # ---------------------------------------------------------------------------
 # Layer 1: in-scan device metrics
@@ -732,15 +734,49 @@ def flight_dump(reason: str) -> Optional[str]:
     return rec.dump(reason) if rec is not None else None
 
 
+#: Default ring capacity when neither the caller nor the environment says
+#: otherwise. ``DAL_FLIGHT_RING`` overrides it process-wide — a long-running
+#: service whose post-mortem needs more than the last 256 events raises it
+#: without a redeploy; the configured capacity rides every dump header.
+_DEFAULT_FLIGHT_RING = 256
+
+
+def flight_ring_capacity(capacity: Optional[int] = None) -> int:
+    """Resolve the flight-recorder ring capacity: an explicit argument wins,
+    else the ``DAL_FLIGHT_RING`` env var, else 256. Non-positive or
+    unparseable values are refused loudly — a zero-capacity ring would
+    silently record nothing, which is the exact failure mode the recorder
+    exists to prevent."""
+    if capacity is None:
+        raw = os.environ.get("DAL_FLIGHT_RING", "")
+        if raw.strip():
+            try:
+                capacity = int(raw)
+            except ValueError:
+                raise ValueError(
+                    f"DAL_FLIGHT_RING={raw!r} is not an integer"
+                ) from None
+        else:
+            capacity = _DEFAULT_FLIGHT_RING
+    if capacity <= 0:
+        raise ValueError(
+            f"flight ring capacity must be positive, got {capacity}"
+        )
+    return int(capacity)
+
+
 def install_flight_recorder(
     path: Optional[str],
-    capacity: int = 256,
+    capacity: Optional[int] = None,
     signals: bool = True,
 ) -> FlightRecorder:
     """Install the process-wide flight recorder (replacing any previous one).
 
-    With ``signals=True`` (drivers; tests pass False to keep the pytest
-    process unhooked) also arms the dump triggers:
+    ``capacity`` None resolves through :func:`flight_ring_capacity`
+    (``DAL_FLIGHT_RING`` env, else 256); whatever wins is recorded in every
+    dump header so a post-mortem reader knows how much history the ring
+    could have held. With ``signals=True`` (drivers; tests pass False to
+    keep the pytest process unhooked) also arms the dump triggers:
 
     - **SIGUSR1** dumps and keeps running — probe a live run from outside
       (``kill -USR1 <pid>``) without disturbing it;
@@ -753,7 +789,7 @@ def install_flight_recorder(
     import sys
 
     global _FLIGHT_RECORDER
-    rec = FlightRecorder(path, capacity)
+    rec = FlightRecorder(path, flight_ring_capacity(capacity))
     _FLIGHT_RECORDER = rec
     if not signals:
         return rec
@@ -810,6 +846,26 @@ def uninstall_flight_recorder() -> None:
     _FLIGHT_RECORDER = None
 
 
+def program_obs_feeds(program: str):
+    """The three ops-plane children every launch tracker feeds — ONE
+    definition of the (family, help) pairs so :class:`LaunchTracker` and the
+    serving ``_ProgramTracker`` can never drift on the shared series names
+    (``dal_recompiles_after_warmup_total`` is CI-gated by name). Returns
+    ``(launches_counter, seconds_histogram, recompiles_counter)``; touching
+    the recompile counter here makes the family render 0 from the first
+    scrape on, before anything could have recompiled."""
+    return (
+        obs.counter("launches", "jitted program launches", program=program),
+        obs.histogram(
+            "launch_seconds", "per-launch wall seconds", program=program
+        ),
+        obs.counter(
+            "recompiles_after_warmup",
+            "jit-cache growths past each program's first call",
+        ),
+    )
+
+
 class LaunchTracker:
     """Per-program compile-vs-execute split + recompile detection.
 
@@ -827,6 +883,11 @@ class LaunchTracker:
         self.seconds_total = 0.0
         self.first_seconds: Optional[float] = None  # the compile call's wall
         self._last_cache = None
+        # Live ops plane (runtime/obs.py): children cached at construction —
+        # the registry lookup must not sit on the per-launch path.
+        self._obs_launches, self._obs_seconds, self._obs_recompiles = (
+            program_obs_feeds(program)
+        )
 
     def veto(self, index: int, reason: Optional[str]) -> None:
         """One vetoed speculative launch (runtime/pipeline.py ``on_veto``):
@@ -835,6 +896,10 @@ class LaunchTracker:
         counts are assertable from the JSONL stream — previously a vetoed
         launch was just silence."""
         self.vetoes += 1
+        obs.counter(
+            "launch_vetoes", "speculative launches proven inactive a priori",
+            program=self.program,
+        ).inc()
         flight_record(
             "launch_veto", program=self.program, index=index,
             reason=reason or "unknown",
@@ -865,12 +930,15 @@ class LaunchTracker:
             and cache > self._last_cache
         )
         self._last_cache = cache
+        self._obs_launches.inc()
+        self._obs_seconds.observe(seconds)
         flight_record(
             "launch", program=self.program, call=self.calls,
             seconds=round(seconds, 6), first_call=self.calls == 1,
             recompiled=recompiled,
         )
         if recompiled:
+            self._obs_recompiles.inc()
             flight_record(
                 "recompile", program=self.program, call=self.calls,
                 cache_size=cache,

@@ -56,6 +56,23 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--fit-budget", type=int, default=None)
     ap.add_argument("--metrics-out", default=None, metavar="PATH")
     ap.add_argument("--checkpoint-dir", default=None)
+    ap.add_argument(
+        "--ops-port", type=int, default=None, metavar="PORT",
+        help="serve the live ops plane (runtime/obs.py) on localhost:PORT — "
+        "/metrics (Prometheus text), /healthz, /varz, /flightz; 0/absent = "
+        "off (ServeConfig.ops_port)",
+    )
+    ap.add_argument(
+        "--slo-latency-ms", type=float, default=None, metavar="MS",
+        help="per-query latency objective: queries answering within MS count "
+        "toward the SLO; enables compliance + burn-rate gauges and the "
+        "summary's slo block (ServeConfig.slo_latency_ms; absent = no SLO)",
+    )
+    ap.add_argument(
+        "--slo-target", type=float, default=None, metavar="FRAC",
+        help="SLO compliance target in (0, 1), e.g. 0.99 "
+        "(ServeConfig.slo_target)",
+    )
     return ap
 
 
@@ -74,6 +91,9 @@ def _serve_config(args):
             ("drift_entropy_shift", "drift_entropy_shift"),
             ("drift_margin_shift", "drift_margin_shift"),
             ("max_staleness", "max_staleness"),
+            ("ops_port", "ops_port"),
+            ("slo_latency_ms", "slo_latency_ms"),
+            ("slo_target", "slo_target"),
         )
         if getattr(args, flag) is not None
     }
@@ -148,6 +168,20 @@ def main(argv=None) -> int:
         writer = MetricsWriter(args.metrics_out, flush_every=64)
         install_exit_flush(writer)
 
+    # Live ops plane: bind BEFORE the service builds so /healthz answers
+    # during cold-start compiles (a 503-until-warm endpoint is still an
+    # endpoint; a connection refused is "is it even running?").
+    ops_server = None
+    if serve.ops_port > 0:
+        from distributed_active_learning_tpu.runtime.obs import OpsServer
+
+        ops_server = OpsServer(port=serve.ops_port).start()
+        print(
+            f"# ops plane: http://127.0.0.1:{ops_server.port}/metrics "
+            "(/healthz /varz /flightz)",
+            flush=True,
+        )
+
     service = ALService(
         cfg, serve, x[:n0], y[:n0], bundle.test_x, bundle.test_y,
         metrics=writer, checkpoint_dir=args.checkpoint_dir,
@@ -168,6 +202,8 @@ def main(argv=None) -> int:
         service.save_checkpoint()
     if writer is not None:
         writer.close()
+    if ops_server is not None:
+        ops_server.stop()
 
     lat = np.asarray(latencies)
     payload = {

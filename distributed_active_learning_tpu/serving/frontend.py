@@ -56,8 +56,14 @@ from typing import Deque, Dict, Optional
 
 import numpy as np
 
-from distributed_active_learning_tpu.runtime import telemetry
+from distributed_active_learning_tpu.runtime import obs, telemetry
 from distributed_active_learning_tpu.serving.tenants import TenantManager
+
+#: /healthz staleness bound for the dispatcher-loop heartbeat: the loop
+#: beats at least every 0.1s when idle, but a fused launch (or a first-time
+#: XLA compile a cold tenant sneaks onto the dispatch path) can hold it for
+#: seconds — the bound must catch a DEAD loop, not a busy one.
+_LOOP_HEARTBEAT_MAX_AGE = 60.0
 
 
 class AdmissionError(RuntimeError):
@@ -135,6 +141,9 @@ class ServiceFrontend:
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
+        # A cleanly-stopped dispatcher must not read as a liveness failure
+        # on a scrape that arrives after shutdown.
+        obs.registry().clear_heartbeat("frontend_loop")
 
     def __enter__(self) -> "ServiceFrontend":
         return self.start()
@@ -182,11 +191,19 @@ class ServiceFrontend:
             q = self._queues.setdefault(req.tenant, collections.deque())
             if len(q) >= cap:
                 self.rejected[req.tenant] = self.rejected.get(req.tenant, 0) + 1
+                obs.counter(
+                    "admission_rejects", "requests refused by admission control",
+                    tenant=req.tenant,
+                ).inc()
                 raise AdmissionError(
                     f"tenant {req.tenant!r} has {len(q)} pending requests "
                     f"(max_pending={cap}); backpressure — retry later"
                 )
             q.append(req)
+            obs.gauge(
+                "frontend_queue_depth", "queued requests per tenant",
+                tenant=req.tenant,
+            ).set(len(q))
             self._cond.notify()
         return req.future
 
@@ -261,15 +278,26 @@ class ServiceFrontend:
                     scores[tid] = q.popleft()
             elif self._credit_ok(tid):
                 scores[tid] = q.popleft()
+        for tid in tids:
+            obs.gauge(
+                "frontend_queue_depth", "queued requests per tenant",
+                tenant=tid,
+            ).set(len(self._queues[tid]))
         if n:
             self._rr = (self._rr + 1) % n
         return scores, ingests, held
 
     def _dispatch_loop(self) -> None:
         while True:
+            # /healthz liveness: one beat per loop pass. The registered
+            # staleness bound means a wedged dispatcher (deadlock, dead
+            # thread) flips the health endpoint to 503 within a minute —
+            # the "event-loop liveness" half of the ops plane.
+            obs.heartbeat("frontend_loop", max_age_seconds=_LOOP_HEARTBEAT_MAX_AGE)
             with self._cond:
                 while self._running and not any(self._queues.values()):
                     self._cond.wait(timeout=0.1)
+                    obs.heartbeat("frontend_loop")
                 if not self._running:
                     return
                 scores, ingests, held = self._collect()
@@ -302,6 +330,10 @@ class ServiceFrontend:
                     for tid, req in scores.items():
                         req.future.set_result(results[tid])
                 except Exception as e:  # noqa: BLE001
+                    # availability accounting happens INSIDE score_many
+                    # (completion-aware: only tenants whose blocks did not
+                    # finish are charged — see tenants.py); here the error
+                    # just routes to the waiting callers
                     for req in scores.values():
                         if not req.future.done():
                             req.future.set_exception(e)

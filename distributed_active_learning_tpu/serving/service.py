@@ -106,7 +106,7 @@ class ALService:
         """The PR-7 key set, byte-compatible (bench.py --mode serve and its
         committed baseline read these names)."""
         t = self._tenant
-        return {
+        out = {
             "queries": t.stats.queries,
             "scored_points": t.stats.scored_points,
             "ingest_blocks": t.stats.ingest_blocks,
@@ -121,6 +121,12 @@ class ALService:
             "labeled": t._labeled,
             "recompiles_after_warmup": self.recompiles_after_warmup(),
         }
+        if t.slo is not None:
+            # present ONLY when ServeConfig configures an objective, so the
+            # PR-7 key set (and the committed serve baseline) is untouched
+            # for SLO-less services
+            out["slo"] = t.slo.snapshot()
+        return out
 
     # -- state passthroughs (tests, __main__, and benches read these) --------
 

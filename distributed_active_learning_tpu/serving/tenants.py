@@ -73,6 +73,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from distributed_active_learning_tpu.config import ExperimentConfig, ServeConfig
+from distributed_active_learning_tpu.runtime import obs
 from distributed_active_learning_tpu.runtime import state as state_lib
 from distributed_active_learning_tpu.runtime import telemetry
 from distributed_active_learning_tpu.serving import drift as drift_lib
@@ -119,6 +120,12 @@ class _ProgramTracker:
         self.calls = 0
         self.recompiles = 0
         self._last_cache = None
+        # Live ops plane: the same three series LaunchTracker feeds, from
+        # the one shared definition (telemetry.program_obs_feeds) so the
+        # CI-gated family names cannot drift between the two trackers.
+        self._obs_launches, self._obs_seconds, self._obs_recompiles = (
+            telemetry.program_obs_feeds(program)
+        )
 
     def record(self, seconds: float, **extra) -> None:
         self.calls += 1
@@ -129,8 +136,11 @@ class _ProgramTracker:
             and self._last_cache is not None
             and cache > self._last_cache
         )
+        self._obs_launches.inc()
+        self._obs_seconds.observe(seconds)
         if recompiled:
             self.recompiles += 1
+            self._obs_recompiles.inc()
             # A silent recompile is exactly the event a dead run's post-
             # mortem needs; the score path's per-query launches stay out of
             # the ring (they'd flush everything else) — recompiles don't.
@@ -176,6 +186,9 @@ class ServeStats:
 
     queries: int = 0
     scored_points: int = 0
+    # Score requests that raised before producing a result (frontend
+    # dispatch errors routed back here): the availability half of the SLO.
+    query_failures: int = 0
     ingest_blocks: int = 0
     ingested_points: int = 0
     refits: int = 0
@@ -276,6 +289,32 @@ class Tenant:
         # the serve-multi bench gate asserts slab_growth_compile stays absent
         # afterwards (the AOT precompile's acceptance criterion).
         self.cause_counts: Dict[str, int] = {}
+        # Live ops plane (runtime/obs.py): per-tenant counters + the cause-
+        # tagged latency histogram, tenant-labeled with the SAME tag the
+        # JSONL events carry so a /metrics series and a summarize_metrics row
+        # name the same tenant. Children cached — the registry lookup stays
+        # off the per-query path; the per-cause histogram children fill
+        # lazily (causes are a tiny closed set).
+        self._obs_queries = obs.counter(
+            "serve_queries", "score queries served", tenant=tenant_id
+        )
+        self._obs_points = obs.counter(
+            "serve_scored_points", "points scored", tenant=tenant_id
+        )
+        self._obs_lat: Dict[str, obs.Histogram] = {}
+        # Per-tenant SLO accounting (ServeConfig.slo_latency_ms > 0): the
+        # combined latency+availability SLI — compliance ratio + multi-
+        # window burn-rate gauges, a periodic `slo` JSONL event, and the
+        # summary/bench `slo_compliance` surface. Off by default.
+        self.slo: Optional[obs.SLOTracker] = None
+        self._slo_gauge_ts = 0.0  # last gauge refresh (monotonic)
+        self._obs_slo_comp: Optional[obs.Gauge] = None
+        self._obs_slo_burn: Dict[str, obs.Gauge] = {}
+        if getattr(serve, "slo_latency_ms", 0.0) > 0.0:
+            self.slo = obs.SLOTracker(
+                serve.slo_latency_ms / 1e3,
+                target=getattr(serve, "slo_target", 0.99),
+            )
 
         host_y = np.asarray(train_y, np.int32)
         self.n_classes = max(int(host_y.max()) + 1, 2) if host_y.size else 2
@@ -469,6 +508,10 @@ class Tenant:
         with self._programs_lock:
             self._programs = {}
         self.stats.bin_refreshes += 1
+        obs.counter(
+            "bin_refreshes", "drift-triggered bin-edge re-quantiles",
+            tenant=self.tenant_id,
+        ).inc()
         self._oob_ema = None
         self._fresh_since_refresh = 0
         self._latency_causes.add("bin_refresh_compile")
@@ -688,6 +731,22 @@ class Tenant:
             cause = "none"
         self._latency_causes.clear()
         self.cause_counts[cause] = self.cause_counts.get(cause, 0) + 1
+        self._obs_queries.inc()
+        self._obs_points.inc(n)
+        hist = self._obs_lat.get(cause)
+        if hist is None:
+            hist = self._obs_lat[cause] = obs.histogram(
+                "serve_latency_seconds",
+                "per-query scoring latency by concurrent cause",
+                tenant=self.tenant_id, cause=cause,
+            )
+        hist.observe(dt)
+        obs.heartbeat("serve_query")
+        if self.slo is not None:
+            self.slo.observe(dt, ok=True)
+            self._update_slo_gauges()
+            if self.metrics is not None and self.stats.queries % 100 == 0:
+                self._emit_slo_event()
         if self.metrics is not None:
             self.metrics.event(
                 "serve_latency", tenant=self.tenant_id,
@@ -696,6 +755,65 @@ class Tenant:
                 cause=cause,
                 batched=batched,
             )
+
+    def note_query_failure(self, error: Exception) -> None:
+        """One score block that FAILED before producing a result
+        (``score_many``'s failure paths charge it completion-aware — only
+        blocks that did not finish): availability accounting — a failed
+        query can never meet the SLO, however fast it failed."""
+        self.stats.query_failures += 1
+        obs.counter(
+            "serve_query_failures", "score requests that raised",
+            tenant=self.tenant_id,
+        ).inc()
+        if self.slo is not None:
+            self.slo.observe(None, ok=False)
+            self._update_slo_gauges(force=True)
+        if self.metrics is not None:
+            self.metrics.event(
+                "serve_error", tenant=self.tenant_id, error=repr(error)[:200],
+            )
+
+    def _update_slo_gauges(self, force: bool = False) -> None:
+        """Refresh the compliance/burn gauges — throttled to ~1/s (burn
+        windows only move at slot granularity, and walking three window
+        deques per QUERY would put real work on the scoring hot path; a
+        scrape reads at most one second of staleness). Failures force an
+        immediate refresh — they are rare and exactly the news."""
+        now = time.monotonic()
+        if not force and now - self._slo_gauge_ts < 1.0:
+            return
+        self._slo_gauge_ts = now
+        comp = self.slo.compliance()
+        if comp is not None:
+            if self._obs_slo_comp is None:
+                self._obs_slo_comp = obs.gauge(
+                    "slo_compliance_ratio",
+                    "lifetime fraction of queries meeting the tenant's SLO",
+                    tenant=self.tenant_id,
+                )
+            self._obs_slo_comp.set(round(comp, 6))
+        for name, rate in self.slo.burn_rates().items():
+            if rate is None:
+                continue
+            g = self._obs_slo_burn.get(name)
+            if g is None:
+                g = self._obs_slo_burn[name] = obs.gauge(
+                    "slo_burn_rate",
+                    "windowed error-budget burn rate (1.0 = sustainable)",
+                    tenant=self.tenant_id, window=name,
+                )
+            g.set(round(rate, 4))
+
+    def _emit_slo_event(self) -> None:
+        if self.metrics is None or self.slo is None:
+            return
+        snap = self.slo.snapshot()
+        burn = snap.pop("burn")
+        self.metrics.event(
+            "slo", tenant=self.tenant_id, **snap,
+            **{f"burn_{name}": rate for name, rate in burn.items()},
+        )
 
     def submit(self, x, y) -> None:
         """Queue arriving points (with their eventual oracle labels — the
@@ -726,6 +844,8 @@ class Tenant:
         touchdown — the quiesce point (checkpoint, shutdown, test barriers)."""
         self._drain_ingest(force=True)
         self._poll_refit(force=True)
+        if self.slo is not None and self.stats.queries:
+            self._emit_slo_event()  # the stream's final compliance word
 
     # -- ingest --------------------------------------------------------------
 
@@ -761,6 +881,15 @@ class Tenant:
         self._fill += count
         self.stats.ingest_blocks += 1
         self.stats.ingested_points += count
+        obs.counter(
+            "ingest_points", "points ingested", tenant=self.tenant_id
+        ).inc(count)
+        obs.gauge(
+            "slab_fill", "slab fill watermark (rows)", tenant=self.tenant_id
+        ).set(self._fill)
+        obs.gauge(
+            "slab_capacity", "slab capacity (rows)", tenant=self.tenant_id
+        ).set(self._slab.capacity)
         self.drift.observe_ingest(count)
         self._observe_block_range(bx, count)
         self._maybe_refresh_bins()
@@ -788,8 +917,16 @@ class Tenant:
             # The wait is still a growth stall, so the cause tag stands
             # (ready stays False for the accounting below).
             self._manager.wait_precompile(self, cap)
+        obs.counter(
+            "slab_growths", "slab capacity growths", tenant=self.tenant_id
+        ).inc()
         if ready:
             self.stats.growths_precompiled += 1
+            obs.counter(
+                "slab_growths_precompiled",
+                "growths that swapped in AOT-precompiled executables",
+                tenant=self.tenant_id,
+            ).inc()
         else:
             self._latency_causes.add("slab_growth_compile")
         telemetry.flight_record(
@@ -858,6 +995,14 @@ class Tenant:
     def _record_refit_dispatch(self, reason: str) -> None:
         self.stats.refits += 1
         self.refit_reasons[reason] = self.refit_reasons.get(reason, 0) + 1
+        obs.counter(
+            "refits", "re-fit chunk dispatches by drift reason",
+            tenant=self.tenant_id, reason=reason,
+        ).inc()
+        obs.gauge(
+            "refit_inflight", "1 while a re-fit chunk is in flight",
+            tenant=self.tenant_id,
+        ).set(1)
         self._latency_causes.add("refit_dispatch")
         telemetry.flight_record(
             "refit", tenant=self.tenant_id,
@@ -929,6 +1074,7 @@ class Tenant:
         self._labeled = n_labeled_after
         self._round_host += n_active
         self.stats.refit_rounds += n_active
+        self._obs_refit_touchdown(n_active)
         if n_active:
             rounds_y, labeled_y, acc_y, _picked_y, active_y = ys[:5]
             active_np = np.asarray(active_y)
@@ -937,6 +1083,20 @@ class Tenant:
             acc_np = np.asarray(acc_y)[active_np]
             round_dicts = telemetry.stacked_metrics_to_dicts(ys[5], active_np)
             self._absorb_rounds(rounds_np, labeled_np, acc_np, round_dicts, dt / n_active)
+
+    def _obs_refit_touchdown(self, n_active: int) -> None:
+        """Ops-plane echo of one re-fit touchdown (single-tenant and
+        tenant-axis batched paths): the in-flight gauge drops, the round
+        counter advances, and /healthz's last-touchdown age resets."""
+        obs.gauge(
+            "refit_inflight", "1 while a re-fit chunk is in flight",
+            tenant=self.tenant_id,
+        ).set(0)
+        obs.counter(
+            "refit_rounds", "AL rounds completed by re-fit chunks",
+            tenant=self.tenant_id,
+        ).inc(n_active)
+        obs.heartbeat("serve_touchdown")
 
     def _absorb_rounds(
         self, rounds_np, labeled_np, acc_np, round_dicts, per_round_seconds
@@ -1050,9 +1210,10 @@ class Tenant:
         return total
 
     def summary(self) -> Dict:
-        return {
+        out = {
             "tenant": self.tenant_id,
             "queries": self.stats.queries,
+            "query_failures": self.stats.query_failures,
             "scored_points": self.stats.scored_points,
             "ingest_blocks": self.stats.ingest_blocks,
             "ingested_points": self.stats.ingested_points,
@@ -1071,6 +1232,11 @@ class Tenant:
             "latency_causes": dict(self.cause_counts),
             "recompiles_after_warmup": self.recompiles_after_warmup(),
         }
+        if self.slo is not None:
+            # the SLO block only exists when an objective is configured, so
+            # SLO-less summaries stay key-for-key what they always were
+            out["slo"] = self.slo.snapshot()
+        return out
 
 
 class _BatchedRefit:
@@ -1148,6 +1314,7 @@ class _BatchedRefit:
             t._labeled = int(host_mask[:cap_i].sum())
             t._round_host += n_active
             t.stats.refit_rounds += n_active
+            t._obs_refit_touchdown(n_active)
             telemetry.flight_record(
                 "touchdown", tenant=tid, program=self.tracker.program,
                 reason=reason, n_active=n_active,
@@ -1367,7 +1534,21 @@ class TenantManager:
             self.score_fallback_reasons[reason] = (
                 self.score_fallback_reasons.get(reason, 0) + 1
             )
-            return {tid: self._tenants[tid].score(requests[tid]) for tid in order}
+            out: Dict[str, np.ndarray] = {}
+            for i, tid in enumerate(order):
+                try:
+                    out[tid] = self._tenants[tid].score(requests[tid])
+                except Exception as e:
+                    # Availability accounting, completion-aware: the failing
+                    # tenant and every tenant NOT yet served count a failed
+                    # query; tenants already served keep their (real) good
+                    # observations — charging everyone would double-count
+                    # requests that completed (frontend callers still see
+                    # the whole call fail; SLO counts what actually ran).
+                    for rem in order[i:]:
+                        self._tenants[rem].note_query_failure(e)
+                    raise
+            return out
         tenants_all = list(self._tenants.values())
         width = tenants_all[0].serve.score_width
         d = int(tenants_all[0]._slab.x.shape[1])
@@ -1383,6 +1564,7 @@ class TenantManager:
             self.poll()  # once per distinct in-flight launch per width-round
             qpad = np.zeros((len(tenants_all), width, d), np.float32)
             n_valid = [0] * len(tenants_all)
+            round_tids = set()
             for i, t in enumerate(tenants_all):
                 tid = t.tenant_id
                 if tid not in arrays or pos[tid] >= arrays[tid].shape[0]:
@@ -1391,11 +1573,25 @@ class TenantManager:
                 pos[tid] += block.shape[0]
                 qpad[i, : block.shape[0]] = block
                 n_valid[i] = block.shape[0]
-            t0 = time.perf_counter()
-            scores, ents = self._batched_score_fn(self._stacked(), jnp.asarray(qpad))
-            scores_np = np.asarray(scores)  # the one blocking fetch = latency
-            dt = time.perf_counter() - t0
-            ents_np = np.asarray(ents)
+                round_tids.add(tid)
+            try:
+                t0 = time.perf_counter()
+                scores, ents = self._batched_score_fn(
+                    self._stacked(), jnp.asarray(qpad)
+                )
+                scores_np = np.asarray(scores)  # the one blocking fetch = latency
+                dt = time.perf_counter() - t0
+                ents_np = np.asarray(ents)
+            except Exception as e:
+                # Block-granular availability accounting (SLO observations
+                # are per width-round): the blocks in the failed launch plus
+                # every block never attempted count one failure per tenant;
+                # width-rounds that already completed keep their good
+                # observations.
+                for tid in order:
+                    if tid in round_tids or pos[tid] < arrays[tid].shape[0]:
+                        self._tenants[tid].note_query_failure(e)
+                raise
             self._batched_score_tracker.record(
                 dt, tenants=sum(1 for n in n_valid if n)
             )
@@ -1689,6 +1885,22 @@ class TenantManager:
             for t in self._tenants.values()
         )
 
+    def slo_summary(self) -> Optional[Dict]:
+        """Aggregate + per-tenant SLO accounting, or None when no resident
+        tenant has an objective configured (the summary key then stays
+        absent — SLO-less deployments keep their exact key set)."""
+        with_slo = [t for t in self._tenants.values() if t.slo is not None]
+        if not with_slo:
+            return None
+        good = sum(t.slo.good for t in with_slo)
+        total = sum(t.slo.total for t in with_slo)
+        return {
+            "good": good,
+            "total": total,
+            "compliance": round(good / total, 6) if total else None,
+            "per_tenant": {t.tenant_id: t.slo.snapshot() for t in with_slo},
+        }
+
     def summary(self) -> Dict:
         per_tenant = {tid: t.summary() for tid, t in self._tenants.items()}
         agg = {
@@ -1698,6 +1910,9 @@ class TenantManager:
                 "refits", "refit_rounds", "slab_growths", "growths_precompiled",
             )
         }
+        slo = self.slo_summary()
+        if slo is not None:
+            agg["slo"] = slo
         return {
             "tenants": len(self._tenants),
             **agg,
@@ -1818,6 +2033,9 @@ class TenantManager:
                         self._batched_chunk_for(sig, members, cap_max, aot=True)
                         job.ok = True
                 self.precompiles += 1
+                obs.counter(
+                    "precompiles", "background AOT capacity precompiles"
+                ).inc()
                 seconds = round(time.perf_counter() - t0, 3)
                 telemetry.flight_record(
                     "precompile", target=job.kind,
@@ -1834,6 +2052,9 @@ class TenantManager:
                 # never kill the worker: the lazy request path still compiles,
                 # the failure is just a (named) lost optimization.
                 self.precompile_errors += 1
+                obs.counter(
+                    "precompile_errors", "failed background AOT builds"
+                ).inc()
                 telemetry.flight_record(
                     "precompile_error", target=job.kind,
                     tenant=job.tenant.tenant_id, capacity=job.capacity,

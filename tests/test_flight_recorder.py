@@ -140,6 +140,12 @@ def test_sigterm_flushes_buffered_writer_and_dumps_recorder(tmp_path):
     assert [e["kind"] for e in doc["events"]].count("launch") == 5
 
 
+@pytest.mark.slow  # ~1.5s subprocess spawn; the live-probe-dump semantics
+# (dump mid-run, process keeps going, later events excluded) now have a
+# tier-1 in-process twin via the ops plane (test_obs.py::
+# test_flightz_is_the_sigusr1_path_over_http — the same rec.dump() while
+# recording continues), and the real-signal delivery path stays covered by
+# the SIGTERM subprocess test above + the slow bench SIGTERM e2e.
 def test_sigusr1_dumps_without_disturbing_the_process(tmp_path):
     flight = str(tmp_path / "flight.json")
     script = textwrap.dedent(f"""

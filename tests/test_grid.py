@@ -110,6 +110,20 @@ def test_grid_one_compile_for_the_matrix(hetero_grid):
     assert grid.recompiles_after_warmup == 0
 
 
+def test_grid_feeds_the_live_ops_plane(hetero_grid):
+    """The progress gauges a mid-flight /metrics scrape of a grid run shows
+    (runtime/obs.py): cells, completed cell-rounds, and the ETA gauge —
+    zeroed once the stream is over. Counters are process-cumulative, so the
+    assertions are one-sided."""
+    from distributed_active_learning_tpu.runtime import obs
+
+    _cfg_, grid = hetero_grid
+    total_rounds = sum(len(c.result.records) for c in grid.cells)
+    assert obs.counter("grid_cell_rounds").value >= total_rounds
+    assert obs.gauge("grid_cells").value == len(grid.cells)
+    assert obs.gauge("grid_eta_seconds").value == 0.0  # the run is over
+
+
 def test_grid_result_helpers_and_band_plot(hetero_grid, tmp_path):
     from distributed_active_learning_tpu.runtime.results import (
         grid_curves,

@@ -1,0 +1,393 @@
+"""Live ops plane (runtime/obs.py): histogram correctness, registry
+rendering, SLO burn accounting, the HTTP exporter, and the flight-ring
+capacity knob.
+
+The load-bearing properties pinned here:
+
+- **Bucket-derived percentiles are honest** — within one (log-scale) bucket
+  width of exact numpy percentiles on adversarial samples (bimodal,
+  heavy-tail), so a /metrics p99 is trustworthy without storing samples.
+- **Merge is exact** — shard-merged histograms are bit-identical (integer
+  counts AND derived percentiles) to single-shard ingestion; the property
+  that lets per-thread/per-tenant series aggregate without error bars.
+- **The exporter speaks Prometheus** — every rendered line parses, counters
+  end _total, histogram buckets are cumulative and consistent.
+- **SLO burn is the SRE form** — bad_fraction / error_budget over bounded
+  windows, with no-data distinguished from no-burn.
+"""
+
+import json
+import re
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from distributed_active_learning_tpu.runtime import obs, telemetry
+
+#: one log-scale bucket width (5 buckets/decade)
+BUCKET_FACTOR = 10.0 ** 0.2
+
+
+def _assert_within_one_bucket(est, exact):
+    assert est is not None and est > 0 and exact > 0
+    assert est <= exact * BUCKET_FACTOR * 1.0001, (est, exact)
+    assert est >= exact / BUCKET_FACTOR / 1.0001, (est, exact)
+
+
+# ---------------------------------------------------------------------------
+# histograms
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("shape", ["bimodal", "heavy_tail"])
+def test_histogram_percentiles_within_one_bucket_of_numpy(shape):
+    """Adversarial latency shapes: a bimodal mix (fast path + refit-stalled
+    tail) and a heavy-tailed pareto. Bucket-derived p50/p90/p99 must sit
+    within one bucket width of the exact sample percentile."""
+    rng = np.random.default_rng(7)
+    if shape == "bimodal":
+        vals = np.concatenate([
+            rng.lognormal(np.log(2e-3), 0.15, 4000),   # ~2ms fast mode
+            rng.lognormal(np.log(0.8), 0.2, 600),      # ~800ms stall mode
+        ])
+    else:
+        vals = np.clip(rng.pareto(1.3, 5000) * 2e-3 + 1e-4, None, 90.0)
+    h = obs.Histogram()
+    for v in vals:
+        h.observe(float(v))
+    assert h.count == len(vals)
+    for q in (0.50, 0.90, 0.99):
+        _assert_within_one_bucket(
+            h.percentile(q), float(np.percentile(vals, 100 * q))
+        )
+
+
+def test_histogram_merge_of_shards_bit_identical_to_single_shard():
+    """Four shards observing interleaved stripes of one sample, merged,
+    must equal the single histogram that saw everything: same integer
+    counts, bit-identical derived percentiles."""
+    rng = np.random.default_rng(3)
+    vals = rng.lognormal(np.log(5e-3), 1.2, 4001)  # odd count, wide spread
+    single = obs.Histogram()
+    shards = [obs.Histogram() for _ in range(4)]
+    for i, v in enumerate(vals):
+        single.observe(float(v))
+        shards[i % 4].observe(float(v))
+    merged = obs.Histogram()
+    for s in shards:
+        merged.merge(s)
+    assert merged.counts == single.counts
+    assert merged.count == single.count
+    for q in (0.0, 0.5, 0.9, 0.99, 1.0):
+        assert merged.percentile(q) == single.percentile(q)
+
+
+def test_histogram_edges_are_fixed_and_merge_refuses_mismatch():
+    h1 = obs.Histogram()
+    h2 = obs.Histogram(edges=(0.1, 1.0, 10.0))
+    with pytest.raises(ValueError, match="different edges"):
+        h1.merge(h2)
+    with pytest.raises(ValueError, match="ascending"):
+        obs.Histogram(edges=(1.0, 1.0))
+    assert h1.percentile(0.5) is None  # empty: no data, not a guess
+    # overflow bucket: values past the last edge report the last edge
+    h2.observe(1e6)
+    assert h2.percentile(0.99) == 10.0
+
+
+# ---------------------------------------------------------------------------
+# registry + rendering
+# ---------------------------------------------------------------------------
+
+#: a Prometheus 0.0.4 exposition line: comment, or name{labels} value
+_PROM_LINE = re.compile(
+    r"^(#.*|[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? "
+    r"(-?[0-9.e+-]+|[+-]Inf|NaN))$"
+)
+
+
+def test_registry_renders_valid_prometheus_text():
+    r = obs.Registry()
+    r.counter("serve_queries", "queries", tenant="t0").inc(3)
+    r.counter("serve_queries", "queries", tenant='we"ird\nname').inc()
+    r.gauge("queue_depth", tenant="t0").set(2)
+    h = r.histogram("latency_seconds", tenant="t0", cause="none")
+    for v in (0.001, 0.003, 0.5):
+        h.observe(v)
+    text = r.render_prometheus()
+    for ln in text.strip().splitlines():
+        assert _PROM_LINE.match(ln), f"unparseable line: {ln!r}"
+    # counters end _total; gauges don't; label values escape
+    assert 'dal_serve_queries_total{tenant="t0"} 3' in text
+    assert r'we\"ird\nname' in text
+    assert 'dal_queue_depth{tenant="t0"} 2' in text
+    # histogram: cumulative buckets, +Inf == _count == observations
+    bucket_counts = [
+        int(m.group(1))
+        for m in re.finditer(
+            r'dal_latency_seconds_bucket\{cause="none",tenant="t0",'
+            r'le="[^"]+"\} (\d+)',
+            text,
+        )
+    ]
+    assert bucket_counts == sorted(bucket_counts)  # cumulative => monotone
+    assert bucket_counts[-1] == 3
+    assert 'dal_latency_seconds_count{cause="none",tenant="t0"} 3' in text
+
+
+def test_registry_get_or_create_and_kind_collision():
+    r = obs.Registry()
+    c = r.counter("things", tenant="a")
+    assert r.counter("things", tenant="a") is c  # same child, cacheable
+    with pytest.raises(ValueError, match="is a counter"):
+        r.gauge("things", tenant="a")
+    with pytest.raises(ValueError, match="metric name"):
+        r.counter("bad name")
+    with pytest.raises(ValueError, match="only go up"):
+        c.inc(-1)
+    snap = r.snapshot()
+    json.dumps(snap)  # /varz must serialize
+    assert snap["metrics"]["things"]["kind"] == "counter"
+
+
+def test_health_heartbeats_and_staleness():
+    r = obs.Registry()
+    assert r.health()["ok"] is True  # no heartbeats = nothing to fail
+    r.heartbeat("frontend_loop", max_age_seconds=0.0)
+    health = r.health()  # age > 0 by the time we read it
+    assert health["ok"] is False
+    assert health["heartbeats"]["frontend_loop"]["fresh"] is False
+    r.heartbeat("serve_touchdown")
+    assert r.health()["last_touchdown_age_seconds"] is not None
+    r.clear_heartbeat("frontend_loop")
+    assert r.health()["ok"] is True  # a stopped loop is not a dead loop
+
+
+# ---------------------------------------------------------------------------
+# SLO burn accounting
+# ---------------------------------------------------------------------------
+
+
+def test_slo_tracker_compliance_and_burn_rates():
+    now = [1000.0]
+    t = obs.SLOTracker(
+        0.1, target=0.9, windows=(("10s", 10.0),), slot_seconds=1.0,
+        clock=lambda: now[0],
+    )
+    for _ in range(8):
+        assert t.observe(0.05) is True          # fast successes
+    assert t.observe(0.5) is False              # over the objective
+    assert t.observe(None, ok=False) is False   # failed query: never good
+    assert t.compliance() == pytest.approx(0.8)
+    # 2 bad of 10 in-window: burn = 0.2 / (1 - 0.9) = 2.0 (budget x2)
+    assert t.burn_rate(10.0) == pytest.approx(2.0)
+    assert t.snapshot()["burn"]["10s"] == pytest.approx(2.0)
+    # the window empties as time passes: no data is None, not zero
+    now[0] += 100.0
+    assert t.burn_rate(10.0) is None
+    assert t.compliance() == pytest.approx(0.8)  # lifetime ratio remains
+    # all-good window burns nothing
+    t.observe(0.01)
+    assert t.burn_rate(10.0) == 0.0
+
+
+def test_slo_tracker_refuses_degenerate_objectives():
+    with pytest.raises(ValueError, match="> 0 seconds"):
+        obs.SLOTracker(0.0)
+    with pytest.raises(ValueError, match="error budget"):
+        obs.SLOTracker(0.1, target=1.0)
+    with pytest.raises(ValueError, match="in \\(0, 1\\)"):
+        obs.SLOTracker(0.1, target=0.0)
+
+
+def test_slo_windowed_state_is_bounded():
+    now = [0.0]
+    t = obs.SLOTracker(
+        0.1, target=0.99, windows=(("1h", 3600.0),), slot_seconds=5.0,
+        clock=lambda: now[0],
+    )
+    for i in range(10_000):
+        now[0] += 3.0
+        t.observe(0.01)
+    assert len(t._slots) <= t._horizon_slots + 1  # pruned past the horizon
+    assert t.total == 10_000
+
+
+# ---------------------------------------------------------------------------
+# the HTTP exporter
+# ---------------------------------------------------------------------------
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return resp.status, resp.headers.get("Content-Type"), resp.read()
+
+
+def test_ops_server_endpoints_end_to_end(tmp_path):
+    r = obs.Registry()
+    r.counter("serve_queries", tenant="t0").inc(2)
+    r.histogram("serve_latency_seconds", tenant="t0", cause="none").observe(0.002)
+    r.heartbeat("serve_touchdown")
+    with obs.OpsServer(registry=r, port=0) as srv:
+        base = f"http://127.0.0.1:{srv.port}"
+        status, ctype, body = _get(f"{base}/metrics")
+        assert status == 200 and ctype.startswith("text/plain")
+        text = body.decode()
+        for ln in text.strip().splitlines():
+            assert _PROM_LINE.match(ln), ln
+        assert "dal_serve_latency_seconds_bucket{" in text
+
+        status, ctype, body = _get(f"{base}/healthz")
+        assert status == 200 and ctype == "application/json"
+        health = json.loads(body)
+        assert health["ok"] is True
+        assert health["last_touchdown_age_seconds"] is not None
+
+        status, _, body = _get(f"{base}/varz")
+        varz = json.loads(body)
+        assert varz["metrics"]["serve_queries"]["series"][0]["value"] == 2
+
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(f"{base}/nope")
+        assert e.value.code == 404
+
+        # every successful scrape counted — the bench's ops_scrapes source
+        assert r.counter("ops_scrapes").value >= 3
+
+        # a stale bounded heartbeat flips /healthz to 503
+        r.heartbeat("frontend_loop", max_age_seconds=0.0)
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(f"{base}/healthz")
+        assert e.value.code == 503
+        assert json.loads(e.value.read())["ok"] is False
+
+
+def test_flightz_is_the_sigusr1_path_over_http(tmp_path):
+    artifact = tmp_path / "flight.json"
+    telemetry.install_flight_recorder(str(artifact), capacity=8, signals=False)
+    try:
+        telemetry.flight_record("launch", program="x", call=1)
+        telemetry.flight_record("touchdown", index=0)
+        with obs.OpsServer(registry=obs.Registry(), port=0) as srv:
+            status, _, body = _get(f"http://127.0.0.1:{srv.port}/flightz")
+            assert status == 200
+            doc = json.loads(body)
+            assert doc["capacity"] == 8
+            assert [e["kind"] for e in doc["events"]] == ["launch", "touchdown"]
+            # the dump artifact landed on disk too, reason-tagged
+            on_disk = json.loads(artifact.read_text())
+            assert on_disk["reason"] == "flightz"
+            assert on_disk["capacity"] == 8
+    finally:
+        telemetry.uninstall_flight_recorder()
+    with obs.OpsServer(registry=obs.Registry(), port=0) as srv:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(f"http://127.0.0.1:{srv.port}/flightz")
+        assert e.value.code == 404  # no recorder installed: named, not a 500
+
+
+# ---------------------------------------------------------------------------
+# flight-ring capacity knob (DAL_FLIGHT_RING)
+# ---------------------------------------------------------------------------
+
+
+def test_flight_ring_capacity_env(tmp_path, monkeypatch):
+    monkeypatch.setenv("DAL_FLIGHT_RING", "32")
+    rec = telemetry.install_flight_recorder(
+        str(tmp_path / "f.json"), signals=False
+    )
+    try:
+        assert rec.capacity == 32
+        for i in range(40):
+            rec.record("ev", i=i)
+        assert len(rec.snapshot()) == 32 and rec.dropped == 8
+        rec.dump("test")
+        header = json.loads((tmp_path / "f.json").read_text())
+        assert header["capacity"] == 32  # the configured size, in the header
+        assert header["dropped"] == 8
+        # an explicit argument beats the env
+        rec2 = telemetry.install_flight_recorder(None, capacity=4, signals=False)
+        assert rec2.capacity == 4
+    finally:
+        telemetry.uninstall_flight_recorder()
+    monkeypatch.setenv("DAL_FLIGHT_RING", "banana")
+    with pytest.raises(ValueError, match="not an integer"):
+        telemetry.flight_ring_capacity()
+    with pytest.raises(ValueError, match="positive"):
+        telemetry.flight_ring_capacity(0)
+    monkeypatch.delenv("DAL_FLIGHT_RING")
+    assert telemetry.flight_ring_capacity() == 256
+
+
+# ---------------------------------------------------------------------------
+# instrumentation feeds + summarizer cross-check
+# ---------------------------------------------------------------------------
+
+
+def test_launch_tracker_feeds_the_default_registry():
+    tr = telemetry.LaunchTracker(None, "obs_test_prog_xyz")
+    tr.record(0.01)
+    tr.record(0.02)
+    tr.veto(3, "max_rounds_bound")
+    assert obs.counter("launches", program="obs_test_prog_xyz").value == 2
+    assert obs.counter("launch_vetoes", program="obs_test_prog_xyz").value == 1
+    assert obs.histogram("launch_seconds", program="obs_test_prog_xyz").count == 2
+
+
+def _load_bench_module(name):
+    import importlib
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "benches"))
+    try:
+        return importlib.import_module(name)
+    finally:
+        sys.path.pop(0)
+
+
+def test_summarize_slo_table_and_unmonitored_cross_check():
+    sm = _load_bench_module("summarize_metrics")
+    events = [
+        {"ts": 100.0, "kind": "serve_latency", "tenant": "a", "seconds": 0.01},
+        {"ts": 100.1, "kind": "serve_latency", "tenant": "b", "seconds": 0.02},
+        # two slo events for a: the LAST wins (lifetime ratios grow)
+        {"ts": 100.2, "kind": "slo", "tenant": "a", "objective_ms": 250.0,
+         "target": 0.99, "compliance": 0.5, "good": 1, "total": 2,
+         "burn_1m": 50.0, "burn_5m": 50.0, "burn_1h": None},
+        {"ts": 100.9, "kind": "slo", "tenant": "a", "objective_ms": 250.0,
+         "target": 0.99, "compliance": 0.998, "good": 499, "total": 500,
+         "burn_1m": 0.2, "burn_5m": 0.2, "burn_1h": 0.2},
+    ]
+    out = sm.summarize(events)
+    assert "== slo ==" in out
+    slo_lines = out.split("== slo ==")[1].splitlines()
+    row_a = next(ln for ln in slo_lines if ln.startswith("a"))
+    assert "99.800" in row_a and "499/500" in row_a and "0.20" in row_a
+    # tenant b has latency traffic but no SLO: the loud cross-check note
+    assert "NO SLO" in out and "b" in out.split("NO SLO")[1]
+    assert "a" not in re.findall(r"configured: ([a-z, ]+)", out)[0].split(", ")
+    # malformed slo events are skipped, never a crash
+    out2 = sm.summarize([
+        {"kind": "slo", "tenant": "c", "compliance": "broken"},
+        {"kind": "slo", "compliance": 1.0},
+    ])
+    assert "== slo ==" not in out2
+
+
+def test_compare_bench_hard_slo_spec():
+    cb = _load_bench_module("compare_bench")
+    spec = next(s for s in cb.DEFAULT_SPECS if s.key == "slo_compliance")
+    assert spec.hard and spec.direction == "higher"
+    report = cb.compare_payloads(
+        {"slo_compliance": 1.0, "ops_scrapes": 20},
+        {"slo_compliance": 0.80, "ops_scrapes": 18},
+    )
+    assert "slo_compliance" in report["hard_regressions"]
+    ok = cb.compare_payloads(
+        {"slo_compliance": 1.0}, {"slo_compliance": 0.97}
+    )
+    assert ok["verdict"] == "ok"

@@ -73,6 +73,11 @@ def _tenant_cfg(i):
         drift_entropy_shift=99.0,
         precompile_ahead=True,
         precompile_headroom_slabs=1.0,
+        # SLO plumbing under the same driven traffic (runtime/obs.py): a
+        # generous objective so CPU-test queries always comply — the
+        # assertions below pin the ACCOUNTING, not the rig's latency.
+        slo_latency_ms=60_000.0,
+        slo_target=0.9,
     )
     return cfg, serve
 
@@ -311,14 +316,62 @@ def test_frontend_admission_and_refit_backpressure(driven_multi, monkeypatch):
         assert not any(f.done() for f in held)
         # the held ingests pile up; the cap pushes back on the producer
         held.append(fe.submit_ingest("t0", bx, by))
+        from distributed_active_learning_tpu.runtime import obs
+
+        rejects_before = obs.counter("admission_rejects", tenant="t0").value
         with pytest.raises(AdmissionError, match="backpressure"):
             fe.submit_ingest("t0", bx, by)
         assert fe.rejected.get("t0") == 1
+        # the ops plane counted the same refusal (live /metrics surface)
+        assert obs.counter("admission_rejects", tenant="t0").value == rejects_before + 1
         assert not any(f.done() for f in held)
     finally:
         t0._inflight = None  # touchdown: held ingests may now drain
         fe.stop(drain=True)
     assert all(f.result(timeout=60)["points"] == 4 for f in held)
+
+
+def test_slo_accounting_and_ops_registry(driven_multi):
+    """The live ops plane saw the driven traffic: per-tenant SLO trackers
+    counted every query as good (the objective is deliberately generous),
+    the summary carries the slo block at both levels, and the default
+    registry holds tenant-tagged latency series a /metrics scrape exports —
+    the tags match the JSONL events' (the cross-check summarize_metrics
+    relies on)."""
+    import re
+
+    from distributed_active_learning_tpu.runtime import obs
+
+    mgr, _, _, _ = driven_multi
+    t0 = mgr.tenant("t0")
+    assert t0.slo is not None
+    assert t0.slo.total >= t0.stats.queries > 0
+    assert t0.slo.compliance() == 1.0  # 60s objective: nothing can miss it
+    assert all(b in (0.0, None) for b in t0.slo.burn_rates().values())
+
+    summ = mgr.summary()
+    assert summ["slo"]["total"] == sum(
+        mgr.tenant(tid).slo.total for tid in mgr.tenant_ids
+    )
+    assert summ["slo"]["compliance"] == 1.0
+    assert summ["per_tenant"]["t0"]["slo"]["objective_ms"] == 60_000.0
+
+    text = obs.registry().render_prometheus()
+    # per-tenant, cause-tagged latency histogram series (the CI scrape bar)
+    assert re.search(
+        r'dal_serve_latency_seconds_bucket\{cause="[a-z_]+",tenant="t0",le=',
+        text,
+    ), text[:2000]
+    assert 'dal_serve_queries_total{tenant="t0"}' in text
+    assert 'dal_slo_compliance_ratio{tenant="t0"} 1.0' in text
+    # the recompile family renders from the first scrape on (value asserted
+    # at 0 by the CI job's fresh process; other suites in THIS process may
+    # legitimately have recorded recompiles)
+    assert re.search(r"^dal_recompiles_after_warmup_total \d+$", text, re.M)
+    # /varz is JSON-serializable end to end
+    import json
+
+    json.dumps(obs.registry().snapshot())
 
 
 def test_summarize_metrics_per_tenant_table():
