@@ -533,6 +533,14 @@ def bench_round(args):
     fused_roof = result.pop("roofline_fused_round", None)
     if fused_roof is not None and isinstance(result["roofline"], dict):
         result["roofline"]["fused_round"] = fused_roof
+    result.update(_bench_pod_select(args, pool, pool_y, mask0, binned))
+    # the hard recompile gate covers every round-mode leg: fold the pod
+    # leg's count into the headline counter next to its named twin
+    pod_rc = result.get("pod_recompiles_after_warmup")
+    if isinstance(pod_rc, int) and isinstance(
+        result.get("recompiles_after_warmup"), int
+    ):
+        result["recompiles_after_warmup"] += pod_rc
     return result
 
 
@@ -669,6 +677,144 @@ def _bench_fused_round(args, pool, pool_y, mask0, binned):
         out["roofline_fused_round"] = attr
     except Exception as e:  # noqa: BLE001 — attribution must not kill a bench
         out["roofline_fused_round"] = {"error": f"{type(e).__name__}: {e}"}
+    return out
+
+
+def _bench_pod_select(args, pool, pool_y, mask0, binned):
+    """Pod-scale distributed selection (ops/round_fused.py
+    ``_sharded_score_select``): the per-shard megakernel + ring-merged top-k
+    swept over data-axis shard counts at FIXED per-shard pool rows — the
+    flat-in-host-count claim. Each leg builds a ``ShardedPallasForest`` on a
+    (S, 1) mesh, shards a ``S x rows`` pool over ``data``, and times the one
+    jitted ``fused_score_select`` launch; only k-row candidate windows cross
+    shards (S - 1 ring hops of ``window * 8`` bytes), so wall time should
+    hold within ~15% from 1 to 8 shards on a real pod. On CPU the shards are
+    XLA virtual host devices and the kernel runs in interpret mode — a
+    scaling-structure and recompile surface, not an absolute-perf one (the
+    smoke gate is ``pod_recompiles_after_warmup == 0``; flatness numbers are
+    recorded, not gated). When ``--metrics-out`` is set, one ``pod_select``
+    JSONL event lands per shard count for ``benches/summarize_metrics.py``.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distributed_active_learning_tpu.ops import round_fused, trees_train
+    from distributed_active_learning_tpu.ops.trees_pallas import (
+        ShardedPallasForest,
+    )
+    from distributed_active_learning_tpu.parallel import make_mesh
+    from distributed_active_learning_tpu.runtime import telemetry
+
+    window = args.window
+    if args.depth > 10:  # forest_eval._GEMM_MAX_DEPTH: no path-matrix form
+        return {"pod_select_skipped": f"depth {args.depth} has no gemm form"}
+
+    # One device-fit GemmForest, shared by every shard-count leg.
+    budget = 1 << (args.train_rows + window - 1).bit_length()
+
+    @jax.jit
+    def fit(codes, y, mask, key):
+        c, yy, w = trees_train.gather_fit_window(codes, y, mask, budget)
+        f, th, v = trees_train.fit_forest_device(
+            c, yy, w, binned.edges, key,
+            n_trees=args.trees, max_depth=args.depth, n_bins=8,
+        )
+        return trees_train.heap_gemm_forest(f, th, v, args.depth)
+
+    gf = jax.block_until_ready(
+        fit(
+            binned.codes, jnp.asarray(pool_y), jnp.asarray(mask0),
+            jax.random.key(11),
+        )
+    )
+
+    # Fixed per-shard rows (the megakernel pads each shard block to its row
+    # tile anyway, so this is also the honest per-shard work unit); the pool
+    # GROWS with the shard count — weak scaling, the pod regime.
+    rows = 512
+    max_s = min(8, len(jax.devices()))
+    shard_counts = [s for s in (1, 2, 4, 8) if s <= max_s]
+    rng = np.random.default_rng(3)
+
+    fns, runs, legs = {}, {}, {}
+    for S in shard_counts:
+        mesh = make_mesh(data=S, model=1, devices=jax.devices()[:S])
+        forest = ShardedPallasForest(gf=gf, mesh=mesh)
+        n = rows * S
+        reps_needed = -(-n // args.pool)
+        x_np = np.tile(pool, (reps_needed, 1))[:n]
+        sel_np = rng.integers(0, 2, size=n).astype(bool)
+        x = jax.device_put(
+            jnp.asarray(x_np), NamedSharding(mesh, P("data", None))
+        )
+        sel = jax.device_put(
+            jnp.asarray(sel_np), NamedSharding(mesh, P("data"))
+        )
+
+        @jax.jit
+        def select(f, xx, mm):
+            return round_fused.fused_score_select(
+                f, xx, mm, "uncertainty", window
+            )
+
+        def run(select=select, forest=forest, x=x, sel=sel):
+            jax.block_until_ready(select(forest, x, sel))
+
+        fns[S], runs[S] = select, run
+        _flight("bench_compile", label=f"round/pod_select/s{S}")
+        t0 = time.perf_counter()
+        run()  # compile
+        legs[S] = {"first_call": time.perf_counter() - t0}
+
+    # Interleaved reps, best-rep seconds per leg (the _bench_fused_round
+    # timing discipline — load drift lands on every shard count equally).
+    reps = 3
+    times = {S: [] for S in shard_counts}
+    _flight("bench_timing_start", label="round/pod_select/interleaved", iters=reps)
+    for _ in range(reps):
+        for S, run in runs.items():
+            t0 = time.perf_counter()
+            run()
+            times[S].append(time.perf_counter() - t0)
+    _flight(
+        "bench_timing_end", label="round/pod_select/interleaved",
+        seconds=round(sum(sum(t) for t in times.values()), 4),
+    )
+    for S in shard_counts:
+        legs[S]["seconds"] = min(times[S])
+
+    recompiles = sum(
+        max((telemetry.jit_cache_size(fn) or 1) - 1, 0) for fn in fns.values()
+    )
+    s_max = shard_counts[-1]
+    sec_max = legs[s_max]["seconds"]
+    out = {
+        "pod_select_shard_counts": shard_counts,
+        "pod_select_per_shard_rows": rows,
+        "pod_select_seconds_by_shards": {
+            str(S): round(legs[S]["seconds"], 4) for S in shard_counts
+        },
+        "pod_select_points_per_second": round(rows * s_max / sec_max, 1),
+        # wall at max shards over wall at 1 shard: ~1.0 = flat scaling
+        "pod_select_flat_ratio": round(sec_max / legs[shard_counts[0]]["seconds"], 3),
+        "pod_recompiles_after_warmup": recompiles,
+    }
+
+    metrics_out = getattr(args, "metrics_out", None)
+    if metrics_out:
+        writer = telemetry.MetricsWriter(metrics_out)
+        for S in shard_counts:
+            writer.event(
+                "pod_select",
+                shards=S,
+                per_shard_rows=rows,
+                per_shard_candidates=min(window, rows),
+                ring_hops=S - 1,
+                select_seconds=round(legs[S]["seconds"], 6),
+                points_per_second=round(rows * S / legs[S]["seconds"], 1),
+            )
+        writer.close()
     return out
 
 
@@ -1409,7 +1555,17 @@ def bench_serve_multi(args):
 
     # The ops endpoint is up for the WHOLE bench (cold start included) —
     # an external scraper (the tier-1 job's curl) may arrive any time.
-    ops_server = OpsServer(port=getattr(args, "ops_port", None) or 0).start()
+    # Primary host only (run.py's --ops-port contract): on a multihost pod
+    # every worker runs this same bench body, and N hosts binding one pinned
+    # port would collide; per-host registries already merge into the
+    # primary's export.
+    from distributed_active_learning_tpu.parallel import multihost
+
+    ops_server = (
+        OpsServer(port=getattr(args, "ops_port", None) or 0).start()
+        if multihost.is_primary()
+        else None
+    )
 
     def make(n, shift=0.0, seed_off=0):
         r = np.random.default_rng(seed_off)
@@ -1483,6 +1639,8 @@ def bench_serve_multi(args):
     stop_scrape = threading.Event()
 
     def scraper():
+        if ops_server is None:  # non-primary host: nothing bound to scrape
+            return
         base = f"http://127.0.0.1:{ops_server.port}"
         while not stop_scrape.is_set():
             try:
@@ -1549,7 +1707,8 @@ def bench_serve_multi(args):
     }
     total_queries = T * per_tenant_queries
     manager.close()
-    ops_server.stop()
+    if ops_server is not None:
+        ops_server.stop()
     slo = summary.get("slo") or {}
     if slo.get("compliance") is None:
         # Every tenant was configured with an SLO and served queries, so a
@@ -1606,7 +1765,7 @@ def bench_serve_multi(args):
             for tid, snap in slo.get("per_tenant", {}).items()
         },
         "ops_scrapes": scrapes[0],
-        "ops_port": ops_server.port,
+        "ops_port": ops_server.port if ops_server is not None else None,
         "serve_multi_tenant_summaries": {
             tid: {
                 k: summary["per_tenant"][tid][k]
@@ -1955,8 +2114,10 @@ def _run_mode(args) -> dict:
     # round grew the PR-10 fused-vs-unfused legs (two extra chunk compiles
     # + their timed reps) on top of the roofline pricing compiles; grid grew
     # the PR-14 scenario-axis leg (one more grid-chunk compile + its stream).
+    # PR-16 added the pod-selection weak-scaling sweep (a fit + one sharded
+    # select compile per shard count) to round.
     _cpu_cost = {
-        "score": 30, "density": 25, "round": 340, "sweep": 90, "grid": 170,
+        "score": 30, "density": 25, "round": 380, "sweep": 90, "grid": 170,
         "serve": 120, "serve-multi": 180, "lal": 30, "neural": 260,
     }
 
@@ -2410,6 +2571,12 @@ def main():
         "the named verdict and fired thresholds ride the output JSON under "
         "'regression' (the bench itself never fails on a regression — "
         "gate with compare_bench.py directly)",
+    )
+    ap.add_argument(
+        "--metrics-out", default=None, metavar="PATH",
+        help="append structured JSONL bench events (round mode: one "
+        "pod_select event per shard-count leg) for "
+        "benches/summarize_metrics.py; absent = no event stream",
     )
     ap.add_argument(
         "--flight-recorder", default=None, metavar="PATH",

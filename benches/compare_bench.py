@@ -66,6 +66,11 @@ DEFAULT_SPECS: List[MetricSpec] = [
     # the PR-10 round megakernel: fused vs unfused chunk, same inputs
     MetricSpec("fused_scan_seconds_per_round", "lower", 0.30),
     MetricSpec("fused_round_speedup", "higher", 0.25),
+    # pod-sharded selection (per-shard megakernel + ring-merged top-k):
+    # throughput at the widest shard count, and the flat-in-shard-count
+    # wall ratio (t_maxS / t_1; interpret-mode CPU smoke is noisy — loose)
+    MetricSpec("pod_select_points_per_second", "higher", 0.30),
+    MetricSpec("pod_select_flat_ratio", "lower", 0.50),
     MetricSpec("pipelined_seconds_per_round", "lower", 0.30),
     MetricSpec("touchdown_hidden_fraction", "higher", 0.50),
     # sweep / grid / serve / lal / neural
@@ -100,6 +105,12 @@ DEFAULT_SPECS: List[MetricSpec] = [
     # round mode's namespaced twin (same --mode all merge hazard)
     MetricSpec(
         "fused_round_recompiles_after_warmup", "lower", 0.0, kind="counter",
+        hard=True,
+    ),
+    # the pod-sharded selection leg's twin: any executable-cache growth
+    # across its interleaved shard-count reps is an architectural regression
+    MetricSpec(
+        "pod_recompiles_after_warmup", "lower", 0.0, kind="counter",
         hard=True,
     ),
     # serve-multi's namespaced twin, plus the AOT-precompile acceptance gate:

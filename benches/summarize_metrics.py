@@ -564,6 +564,42 @@ def summarize(events: List[dict]) -> str:
             )
         )
 
+    # Pod-scale selection legs (bench.py --mode round --metrics-out): one
+    # event per shard count in the weak-scaling sweep. Per-shard candidate
+    # counts and ring hop counts make the collective geometry legible next
+    # to the merge wall time. Defensive like the serve tables: a malformed
+    # event (missing / non-numeric / bool-typed fields) is skipped.
+    pod_events = [
+        e for e in events
+        if e.get("kind") == "pod_select"
+        and _num(e, "shards") is not None
+        and _num(e, "select_seconds") is not None
+    ]
+    if pod_events:
+        rows = []
+        for e in sorted(pod_events, key=lambda e: e["shards"]):
+            def _i(key):
+                v = _num(e, key)
+                return int(v) if v is not None else "-"
+
+            pps = _num(e, "points_per_second")
+            rows.append([
+                int(e["shards"]),
+                _i("per_shard_rows"),
+                _i("per_shard_candidates"),
+                _i("ring_hops"),
+                f"{e['select_seconds']:.4f}",
+                f"{pps:,.0f}" if pps is not None else "-",
+            ])
+        out.append(
+            "\n== pod selection ==\n"
+            + _table(
+                ["shards", "per-shard rows", "per-shard candidates",
+                 "ring hops", "select s", "points/s"],
+                rows,
+            )
+        )
+
     streamed = [e for e in events if e.get("kind") == "round_stream"]
     if streamed:
         out.append(

@@ -74,8 +74,8 @@ LABEL_CAP = 40
 FIT_BUDGET = 48
 
 KINDS = (
-    "chunk", "fused_chunk", "fused_select", "sweep", "grid", "neural_sweep",
-    "neural_chunk", "serve", "serve_multi", "scenario",
+    "chunk", "fused_chunk", "fused_select", "pod_select", "sweep", "grid",
+    "neural_sweep", "neural_chunk", "serve", "serve_multi", "scenario",
 )
 GRID_D = 2   # datasets in the audited grid program
 GRID_E = 2   # seeds per (strategy, dataset)
@@ -395,6 +395,64 @@ def _build_fused_select(
         # of the narrow operand layouts.
         pallas_tiles=_pallas_tiles(quantize=quantize),
     )
+
+
+def _build_pod_select(
+    name: str, placement: str, mesh_shape=MESH_SHAPE
+) -> AuditUnit:
+    """The POD-SHARDED round selection (ops/round_fused.py
+    ``_sharded_score_select`` via ``fused_score_select`` on a
+    ``ShardedPallasForest``): per-shard megakernel -> local masked top-k ->
+    ring-merged global window (ops/ring_topk.py). Mesh-only by construction
+    — the single-device spelling is the ``fused_select`` kind — and the
+    exact surface the pool-scale sharding rules must hold on: the only
+    collectives are the model-axis vote psum and the k-row ring exchange,
+    never a pool-sized operand."""
+    from distributed_active_learning_tpu.ops import round_fused
+    from distributed_active_learning_tpu.ops.trees_pallas import (
+        ShardedPallasForest,
+    )
+
+    if placement == "cpu":
+        raise SkipProgram(
+            "pod selection is the sharded spelling of the round megakernel "
+            "(the cpu spelling is the fused_select kind); no cpu placement"
+        )
+    mesh = _mesh_or_skip(mesh_shape)
+    gf = jax.eval_shape(
+        _device_fit("gemm"),
+        _sds((POOL_ROWS, FEATURES), jnp.int32),
+        _abstract_state(),
+        _key_sds(),
+    )
+    forest = ShardedPallasForest(gf=gf, mesh=mesh)
+
+    @jax.jit
+    def select(f, x, mask):
+        return round_fused.fused_score_select(f, x, mask, name, WINDOW)
+
+    args = (
+        forest,
+        _sds((POOL_ROWS, FEATURES), jnp.float32),
+        _sds((POOL_ROWS,), jnp.bool_),
+    )
+    return AuditUnit(
+        name=f"pod_select/{name}/{placement}",
+        fn=select,
+        args=args,
+        expect_donation=False,
+        pool_rows=POOL_ROWS,
+        pallas_tiles=_pallas_tiles(mesh_shape=mesh_shape),
+    )
+
+
+def pod_select_names() -> List[str]:
+    """The pod-sharded selection axis: every fused strategy (quantized
+    storage spellings ride the fused_select/fused_chunk kinds — the narrow
+    operand layouts are placement-independent)."""
+    from distributed_active_learning_tpu.ops.round_fused import FUSED_STRATEGIES
+
+    return sorted(FUSED_STRATEGIES)
 
 
 def fused_select_names() -> List[str]:
@@ -1033,6 +1091,10 @@ def build_registry(
         # the STANDALONE megakernel selection (eval -> score -> top-k in one
         # call, outside the chunk scan): the memory planner's VMEM subject
         ("fused_select", _build_fused_select, fused_select_names()),
+        # the pod-sharded spelling of the same selection (per-shard megakernel
+        # + ring-merged top-k): mesh-only — the placement where its
+        # collective/sharding contract exists at all
+        ("pod_select", _build_pod_select, pod_select_names()),
         ("sweep", _build_sweep, forest_strategy_names()),
         # one fixed heterogeneous group set: the grid program's novelty is
         # the multi-strategy merge itself, not per-strategy variants (each
@@ -1055,15 +1117,17 @@ def build_registry(
             continue
         # the neural loop and the single-tenant serving programs have a
         # single (cpu) placement — emit it only when cpu was requested, so a
-        # mesh-only filter doesn't smuggle cpu programs back into the audit
-        kind_placements = (
-            (("cpu",) if "cpu" in placements else ())
-            if kind in (
-                "neural_sweep", "neural_chunk", "serve", "fused_select",
-                "scenario",
-            )
-            else placements
-        )
+        # mesh-only filter doesn't smuggle cpu programs back into the audit;
+        # pod_select is the inverse (mesh placements only)
+        if kind in (
+            "neural_sweep", "neural_chunk", "serve", "fused_select",
+            "scenario",
+        ):
+            kind_placements = ("cpu",) if "cpu" in placements else ()
+        elif kind == "pod_select":
+            kind_placements = tuple(p for p in placements if p != "cpu")
+        else:
+            kind_placements = placements
         for name in names:
             if not want(name):
                 continue

@@ -20,12 +20,15 @@ pool slab crosses VMEM ONCE per round:
   with the exact GEMM tile body (``trees_gemm._predict_chunk``), so
   per-tile intermediates stay cache-resident instead of round-tripping a
   ``[pool, trees]`` tensor through memory.
-- **mesh (ShardedPallasForest)**: per-shard fused vote accumulation under
-  ``shard_map`` (rows over ``data``, trees over ``model``) + one psum — the
-  ``[n_local, T_local]`` leaf matrix never materializes per shard — then the
-  score + global top-k run on the psum'd ``[n]`` votes (selection still
-  funnels globally; fully-distributed selection is the pod-sharding ROADMAP
-  item).
+- **mesh (ShardedPallasForest)**: fully-distributed selection in ONE
+  ``shard_map`` (rows over ``data``, trees over ``model``): each shard runs
+  the fused vote kernel on its (row block, tree shard), one psum over
+  ``model`` completes the votes, and the shard scores + extracts its local
+  top-k window in place — the ``[n_local, T_local]`` leaf matrix AND the
+  global score vector never materialize. The global top-k is then a ring
+  merge of k-row candidate windows over ``data`` (``ops/ring_topk.py``):
+  ``S - 1`` neighbor hops of ``k * 8`` bytes each, no pool-scale collective
+  anywhere — the pod-sharding contract the PR-13 auditor rules gate.
 
 Both single-device paths emit per-tile candidates merged by
 ``ops.topk.merge_tile_topk``; the merge (and the tie-break argument for its
@@ -66,12 +69,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from distributed_active_learning_tpu.ops import scoring
 from distributed_active_learning_tpu.ops import trees_pallas
-from distributed_active_learning_tpu.ops.topk import (
-    NEG_INF,
-    merge_tile_topk,
-    select_bottom_k,
-    select_top_k,
-)
+from distributed_active_learning_tpu.ops.topk import NEG_INF, merge_tile_topk
 from distributed_active_learning_tpu.ops.trees_gemm import (
     GemmForest,
     _predict_chunk,
@@ -377,6 +375,85 @@ def _sharded_fused_votes(f: ShardedPallasForest, x: jnp.ndarray) -> jnp.ndarray:
     return kern(f.gf, x)[:n]
 
 
+def _sharded_score_select(
+    f: ShardedPallasForest,
+    x: jnp.ndarray,
+    selectable: jnp.ndarray,
+    strategy_name: str,
+    k: int,
+):
+    """Fully-distributed fused selection: per-shard votes + score + local
+    top-k, then a ring merge of k-row candidate windows over ``data``
+    (``ops/ring_topk.py``) — selection never funnels through a global score
+    vector or a pool-scale collective.
+
+    Bit-identity with the single-mesh global top-k: inside the shard_map the
+    directed score of every row is computed from the SAME psum'd integer
+    votes (elementwise, so per-shard blocks carry identical bits to the
+    global vector), local windows come from ``lax.top_k`` over the masked
+    block (value desc, position asc — position = global index within a
+    contiguous block), and the ring merge's (value desc, index asc) order is
+    exactly ``lax.top_k``'s full-vector order. Unselectable and padding rows
+    are -inf with real/IDX_SENTINEL indices, so the sentinel tail when fewer
+    than ``k`` rows remain matches ``select_top_k``'s tail contract too.
+
+    Returns DIRECTED ``(vals [k], idx [k])`` replicated across the mesh; the
+    dispatch un-negates ascending strategies, mirroring the tile path.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from distributed_active_learning_tpu.ops import ring_topk as ring_lib
+    from distributed_active_learning_tpu.parallel import mesh as mesh_lib
+    from distributed_active_learning_tpu.parallel.collectives import (
+        vector_accumulate,
+    )
+    from distributed_active_learning_tpu.utils.compat import shard_map
+
+    n_shards = f.mesh.shape[mesh_lib.AXIS_DATA]
+    x = _pad_to(x, 0, n_shards)
+    selectable = _pad_to(selectable, 0, n_shards)  # pads False: unselectable
+    n_local = x.shape[0] // n_shards
+    kk = min(k, n_local)
+    gf_specs = mesh_lib.forest_tree_specs(f.gf)
+
+    @functools.partial(
+        shard_map,
+        mesh=f.mesh,
+        in_specs=(
+            gf_specs,
+            P(mesh_lib.AXIS_DATA, None),
+            P(mesh_lib.AXIS_DATA),
+        ),
+        out_specs=(P(), P()),
+        # pallas_call declares its out_shape without varying-mesh-axes
+        # annotations, and the ring merge's replicated outputs hold by
+        # construction (every shard converges to the same global winners) —
+        # same waiver as _sharded_fused_votes.
+        check_vma=False,
+    )
+    def kern(gf_local, x_blk, sel_blk):
+        local = fused_votes_pallas(
+            gf_local, x_blk, interpret=trees_pallas._use_interpret()
+        )
+        votes = vector_accumulate(local, mesh_lib.AXIS_MODEL)
+        s, _ = _score_from_votes(
+            votes.astype(jnp.float32), f.n_trees, strategy_name
+        )
+        work = jnp.where(sel_blk, s, NEG_INF)
+        loc_v, loc_i = lax.top_k(work, kk)
+        glob_i = (
+            lax.axis_index(mesh_lib.AXIS_DATA) * n_local + loc_i
+        ).astype(jnp.int32)
+        win_v, win_i = ring_lib.pad_window(loc_v, glob_i, k)
+        return ring_lib.ring_topk(
+            win_v, win_i, k, mesh_lib.AXIS_DATA,
+            mesh_axis_names=f.mesh.axis_names,
+        )
+
+    with jax.named_scope("fused_round/pod_select"):
+        return kern(f.gf, x, selectable)
+
+
 # ---------------------------------------------------------------------------
 # the dispatch
 # ---------------------------------------------------------------------------
@@ -393,7 +470,8 @@ def fused_score_select(
     the unfused score vector (including the ascending strategies' sign
     convention). Dispatches on the forest pytree type like the rest of
     ``ops/forest_eval``: pallas forests take the megakernel, gemm forests
-    the XLA stream, mesh-wrapped forests the per-shard fused-votes path.
+    the XLA stream, mesh-wrapped forests the pod-sharded path (per-shard
+    megakernel + ring-merged top-k, ``_sharded_score_select``).
     """
     if strategy_name not in FUSED_STRATEGIES:
         raise ValueError(
@@ -403,12 +481,10 @@ def fused_score_select(
     _, higher = FUSED_STRATEGIES[strategy_name]
     with jax.named_scope("fused_round/score_select"):
         if isinstance(forest, ShardedPallasForest):
-            votes = _sharded_fused_votes(forest, x)
-            p = votes.astype(jnp.float32) / forest.n_trees
-            scores = FUSED_STRATEGIES[strategy_name][0](p)
-            if higher:
-                return select_top_k(scores, selectable_mask, k)
-            return select_bottom_k(scores, selectable_mask, k)
+            vals, idx = _sharded_score_select(
+                forest, x, selectable_mask, strategy_name, k
+            )
+            return (vals, idx) if higher else (-vals, idx)
         gf = forest.gf if isinstance(forest, PallasForest) else forest
         if not isinstance(gf, GemmForest):
             raise TypeError(

@@ -22,6 +22,18 @@ def vector_accumulate(local: jnp.ndarray, axis_name: str) -> jnp.ndarray:
     return lax.psum(local, axis_name)
 
 
+def global_count(local_mask: jnp.ndarray, axis_name: str) -> jnp.ndarray:
+    """Global True-count of a per-shard boolean mask: local sum + one scalar
+    psum over ``axis_name``.
+
+    The shard_map spelling of the pod bookkeeping scalars
+    (``runtime.state.labeled_count`` / ``filled_count`` under a sharded
+    mask): the collective moves ONE int32 per shard — never the mask — so
+    budget/stop checks stay candidate-window-cheap at pod scale.
+    """
+    return lax.psum(jnp.sum(local_mask.astype(jnp.int32)), axis_name)
+
+
 def masked_mean(values: jnp.ndarray, mask: jnp.ndarray, axis_name: str) -> jnp.ndarray:
     """Global mean of ``values`` where ``mask`` is set, across shards.
 

@@ -96,6 +96,25 @@ def global_put(x, mesh: Mesh, spec: P):
     return jax.make_array_from_callback(arr.shape, sharding, lambda idx: arr[idx])
 
 
+def shard_fill_watermark(
+    n_filled: jnp.ndarray, n_pool: int, n_shards: int
+) -> jnp.ndarray:
+    """Split a global scalar fill watermark into the per-shard ``[S]`` leaf.
+
+    Shard ``s`` owns the contiguous row block ``[s * rows, (s + 1) * rows)``
+    (``rows = n_pool // n_shards``); a contiguously-filled pool therefore
+    fills shard ``s`` to ``clip(n_filled - s * rows, 0, rows)``. The masks
+    this leaf induces (``PoolState.fill_mask``) are identical to the scalar's
+    — pinned by the parity test — while each shard now owns its own
+    watermark, so per-shard ingest can advance it without a global
+    renumbering and the global view is the psum'd sum
+    (``runtime.state.filled_count``).
+    """
+    rows = n_pool // n_shards
+    base = jnp.arange(n_shards, dtype=jnp.int32) * rows
+    return jnp.clip(jnp.asarray(n_filled, jnp.int32) - base, 0, rows)
+
+
 def shard_pool_state(state: PoolState, mesh: Mesh) -> PoolState:
     """Place pool arrays with rows sharded over the data axis.
 
@@ -103,6 +122,12 @@ def shard_pool_state(state: PoolState, mesh: Mesh) -> PoolState:
     :func:`runtime.state.pad_for_sharding` (``run_experiment`` does this when
     a >1-device mesh is configured); this function raises otherwise rather
     than let a shard_map kernel fail with an opaque block-shape error.
+
+    A scalar ``n_filled`` watermark becomes the per-shard ``[S]`` leaf placed
+    ``P(data)`` (:func:`shard_fill_watermark`) — replicating the scalar
+    (the pre-pod behavior) left every shard consulting a GLOBAL watermark
+    that goes stale the moment one shard ingests on its own. An already
+    per-shard leaf is validated against the mesh and re-placed as-is.
     """
     n = state.n_pool
     data_axis = mesh.shape[AXIS_DATA]
@@ -111,6 +136,16 @@ def shard_pool_state(state: PoolState, mesh: Mesh) -> PoolState:
             f"pool size {n} not divisible by data axis {data_axis}; call "
             "runtime.state.pad_for_sharding first"
         )
+    n_filled = state.n_filled
+    if n_filled is not None:
+        n_filled = jnp.asarray(n_filled)
+        if n_filled.ndim == 0:
+            n_filled = shard_fill_watermark(n_filled, n, data_axis)
+        elif n_filled.shape != (data_axis,):
+            raise ValueError(
+                f"per-shard n_filled leaf {n_filled.shape} does not match "
+                f"the data axis ({data_axis} shards)"
+            )
     return state.replace(
         x=global_put(state.x, mesh, pool_spec()),
         oracle_y=global_put(state.oracle_y, mesh, mask_spec()),
@@ -119,8 +154,8 @@ def shard_pool_state(state: PoolState, mesh: Mesh) -> PoolState:
         round=global_put(state.round, mesh, replicated_spec()),
         n_filled=(
             None
-            if state.n_filled is None
-            else global_put(state.n_filled, mesh, replicated_spec())
+            if n_filled is None
+            else global_put(n_filled, mesh, P(AXIS_DATA))
         ),
     )
 

@@ -338,17 +338,31 @@ def main(argv=None) -> int:
         telemetry.install_flight_recorder(args.flight_recorder)
 
     if args.ops_port:
-        # Bound before any compile so /healthz answers from second one; the
-        # serve thread is a daemon — it dies with the run, no teardown path
-        # needed across this function's many exits.
-        from distributed_active_learning_tpu.runtime.obs import OpsServer
+        # Primary host only: on a multihost pod every worker runs this same
+        # main(), and N hosts binding the same --ops-port would collide (and
+        # per-host metrics registries already merge into the primary's
+        # export). Non-primary hosts log the skip so an operator probing a
+        # worker's port gets a pointer instead of silence.
+        if multihost.is_primary():
+            # Bound before any compile so /healthz answers from second one;
+            # the serve thread is a daemon — it dies with the run, no
+            # teardown path needed across this function's many exits.
+            from distributed_active_learning_tpu.runtime.obs import OpsServer
 
-        ops_server = OpsServer(port=args.ops_port).start()
-        print(
-            f"# ops plane: http://127.0.0.1:{ops_server.port}/metrics "
-            "(/healthz /varz /flightz)",
-            file=sys.stderr, flush=True,
-        )
+            ops_server = OpsServer(port=args.ops_port).start()
+            print(
+                f"# ops plane: http://127.0.0.1:{ops_server.port}/metrics "
+                "(/healthz /varz /flightz)",
+                file=sys.stderr, flush=True,
+            )
+        else:
+            import jax
+
+            print(
+                f"# ops plane: skipped on host {jax.process_index()} "
+                "(primary host binds --ops-port)",
+                file=sys.stderr, flush=True,
+            )
 
     # phase_detail defaults False since the telemetry PR: an enabled Debugger
     # no longer costs a fused run its scan fusion (per-round visibility comes

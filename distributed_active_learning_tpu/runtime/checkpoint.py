@@ -47,6 +47,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from distributed_active_learning_tpu.parallel import mesh as mesh_lib
+from distributed_active_learning_tpu.runtime import state as state_lib
 from distributed_active_learning_tpu.runtime.results import ExperimentResult, RoundRecord
 from distributed_active_learning_tpu.runtime.state import PoolState
 
@@ -630,7 +632,24 @@ def save_serve(
     _serve_step_re(tenant)  # validates the id before any work
     if state.n_filled is None:
         raise ValueError("save_serve needs a slab-paged state (n_filled set)")
-    fill = int(state.n_filled)
+    # Global watermark for either spelling (scalar, or the pod-sharded [S]
+    # per-shard leaf). The [:fill] slices below assume contiguous fill — true
+    # for the scalar contract and for shard_fill_watermark-split pools; a
+    # pool with genuinely independent per-shard ingest has holes a slice
+    # cannot express, so refuse rather than silently drop rows.
+    fill = int(state_lib.filled_count(state))
+    if state.n_filled.ndim and not bool(
+        np.asarray(
+            state.n_filled
+            == mesh_lib.shard_fill_watermark(
+                fill, state.n_pool, state.n_filled.shape[0]
+            )
+        ).all()
+    ):
+        raise ValueError(
+            "save_serve needs a contiguously-filled pool; this per-shard "
+            f"watermark {np.asarray(state.n_filled)} has gaps"
+        )
     # Like save()/save_neural(), the payload is built BEFORE the primary-only
     # gate: host_np is a collective for multi-process sharded arrays, so
     # every rank must reach it (serving is single-process today, but this

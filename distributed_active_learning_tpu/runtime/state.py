@@ -41,12 +41,21 @@ class PoolState:
     # valid_mask. Static (not a pytree leaf) so jitted rounds specialize on it.
     n_valid_static: int = struct.field(pytree_node=False, default=-1)
     # Dynamic fill watermark (slab-paged streaming pools, serving/slab.py):
-    # a TRACED int32 scalar — rows at/past it are allocated-but-unfilled slab
+    # a TRACED int32 leaf — rows past it are allocated-but-unfilled slab
     # capacity, excluded from selection, fit gathers, and every statistic via
     # the dynamic masks below. A leaf (unlike n_valid_static) so ingest can
     # advance it launch-to-launch without changing any program's avals —
     # arrivals never retrigger compilation. None (batch pools) keeps every
     # mask/count on the static fast path, bit-identical to the pre-slab code.
+    # Two spellings:
+    #   - scalar: one global watermark, rows [0, n_filled) filled (the
+    #     single-device slab contract, unchanged);
+    #   - [S] per-shard (pod-sharded pools, parallel.mesh.shard_pool_state):
+    #     the pool splits into S contiguous row blocks of n_pool // S rows
+    #     and n_filled[s] is shard s's OWN watermark — the leaf lives
+    #     P(data), so per-shard ingest advances it without a global
+    #     renumbering, and the global filled count is the (psum-shaped) sum
+    #     over shards (:func:`filled_count`).
     n_filled: Optional[jnp.ndarray] = None
 
     @property
@@ -58,10 +67,30 @@ class PoolState:
         return self.n_pool if self.n_valid_static < 0 else self.n_valid_static
 
     @property
+    def fill_mask(self) -> jnp.ndarray:
+        """Rows below the fill watermark; all-True when no watermark is set.
+
+        Handles both watermark spellings: a scalar compares against the
+        global row index; a per-shard ``[S]`` leaf compares each shard's
+        block-local row index against that shard's own watermark (block s =
+        rows ``[s * rows, (s + 1) * rows)`` with ``rows = n_pool // S`` —
+        the contiguous-block layout ``shard_pool_state`` places over
+        ``data``).
+        """
+        if self.n_filled is None:
+            return jnp.ones(self.n_pool, dtype=bool)
+        if self.n_filled.ndim == 0:
+            return jnp.arange(self.n_pool) < self.n_filled
+        (n_shards,) = self.n_filled.shape
+        rows = self.n_pool // n_shards
+        local = jnp.arange(self.n_pool) % rows
+        return local < jnp.repeat(self.n_filled, rows)
+
+    @property
     def valid_mask(self) -> jnp.ndarray:
         mask = jnp.arange(self.n_pool) < self.n_valid
         if self.n_filled is not None:
-            mask = mask & (jnp.arange(self.n_pool) < self.n_filled)
+            mask = mask & self.fill_mask
         return mask
 
     @property
@@ -70,7 +99,7 @@ class PoolState:
         # mask) and are excluded here instead, so strategies/selection see
         # exactly the filled unlabeled rows.
         if self.n_filled is not None:
-            return ~self.labeled_mask & (jnp.arange(self.n_pool) < self.n_filled)
+            return ~self.labeled_mask & self.fill_mask
         return ~self.labeled_mask
 
     def visible_y(self, fill: int = -1) -> jnp.ndarray:
@@ -87,6 +116,23 @@ def labeled_count(state: PoolState) -> jnp.ndarray:
 
 def unlabeled_count(state: PoolState) -> jnp.ndarray:
     return jnp.sum(state.unlabeled_mask.astype(jnp.int32))
+
+
+def filled_count(state: PoolState) -> jnp.ndarray:
+    """Global filled-row count as one int32 scalar.
+
+    The budget/stop-scalar view of the watermark: for a per-shard ``[S]``
+    leaf this is the sum over shards — under GSPMD the jnp.sum of a
+    ``P(data)``-placed leaf lowers to the same S-int all-reduce a
+    ``lax.psum`` inside a shard_map body spells (``parallel.collectives
+    .global_count`` is that explicit twin); for the scalar spelling it is
+    the watermark itself, bit-identical to the pre-pod code.
+    """
+    if state.n_filled is None:
+        return jnp.asarray(state.n_valid, jnp.int32)
+    if state.n_filled.ndim == 0:
+        return state.n_filled.astype(jnp.int32)
+    return jnp.sum(state.n_filled).astype(jnp.int32)
 
 
 def init_pool_state(x, y, key: jax.Array) -> PoolState:

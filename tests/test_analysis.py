@@ -422,7 +422,7 @@ def test_mesh_chunk_audits_clean(devices):
 @pytest.mark.slow  # the full matrix (~80+ traced programs, ~60s) runs in CI
 def test_full_registry_audits_clean():
     report = run_audit(build_registry())
-    assert len(report.programs) >= 54
+    assert len(report.programs) >= 58
     assert report.findings == [], [str(f) for f in report.findings]
 
 
@@ -1063,6 +1063,86 @@ def test_fused_select_program_audits_clean():
     )
     assert report.programs == ["fused_select/uncertainty/cpu"]
     assert report.findings == [], [str(f) for f in report.findings]
+
+
+def test_registry_covers_pod_select_kind(devices):
+    """The pod-sharded selection (per-shard megakernel + ring-merged top-k)
+    audits per fused strategy — mesh-only (the cpu spelling is the
+    fused_select kind) — and carries the PER-SHARD pallas tile claim: the
+    kernel runs on the data-axis block, not the pool."""
+    from distributed_active_learning_tpu.ops.round_fused import FUSED_STRATEGIES
+
+    specs = build_registry(kinds=["pod_select"])
+    names = {s.name for s in specs}
+    for strat in FUSED_STRATEGIES:
+        assert f"pod_select/{strat}/mesh4x2" in names
+    assert not any("/cpu" in n for n in names)
+    # a cpu-only placement filter must not smuggle pod programs back in
+    assert build_registry(kinds=["pod_select"], placements=["cpu"]) == []
+    unit = next(
+        s for s in specs if s.name == "pod_select/uncertainty/mesh4x2"
+    ).build()
+    assert unit.pool_rows == 64
+    assert unit.pallas_tiles is not None
+    assert unit.pallas_tiles["n_rows"] == 64 // 4  # the data-axis block
+
+
+def test_pod_select_program_audits_clean(devices):
+    """The distributed selection's collectives are the model-axis vote psum
+    and the k-row ring exchange — nothing pool-sized crosses ICI, so the
+    sharding rules (replicated-pool-operand / pool-scale-collective /
+    collective-bytes-over-budget) must all hold on the traced program."""
+    report = run_audit(
+        build_registry(
+            strategies=["uncertainty"], kinds=["pod_select"],
+            placements=["mesh4x2"],
+        )
+    )
+    assert report.programs == ["pod_select/uncertainty/mesh4x2"]
+    assert report.findings == [], [str(f) for f in report.findings]
+
+
+def test_auditor_catches_pool_scale_ring(devices):
+    """A ring that circulates whole pool blocks instead of k-row candidate
+    windows must blow the collective byte budget — the contract the
+    pod_select programs are audited against. The planted ring ships the
+    [16]-row data block on every one of the S-1 hops; a budget set at
+    k-window traffic (what ops/ring_topk.py actually moves) catches it."""
+    from distributed_active_learning_tpu.utils.compat import shard_map
+
+    mesh, P = _mesh_and_P(devices)
+    perm = [(j, (j + 1) % 4) for j in range(4)]
+
+    @jax.jit
+    def planted(x):
+        def body(xb):
+            def hop(c, _):
+                return jax.lax.ppermute(c, "data", perm), None
+
+            out, _ = jax.lax.scan(hop, xb, None, length=3)
+            return (xb * out).sum()
+
+        return shard_map(
+            body, mesh=mesh, in_specs=P("data"), out_specs=P(None),
+            check_vma=False,
+        )(x)
+
+    # a k=5 window ring moves (5 values + 5 idx) x 4 B x 3 hops = 120 B per
+    # launch; the planted pool-block ring moves 16 x 4 B x 3 hops = 192 B
+    args = (_sds((64,), jnp.float32),)
+    stats = {}
+    findings = audit_unit(
+        AuditUnit(
+            name="fixture/pool-ring", fn=planted, args=args,
+            pool_rows=64, collective_bytes_budget=120.0,
+        ),
+        stats=stats,
+    )
+    assert stats["collective_bytes"] == 192.0
+    assert "collective-bytes-over-budget" in _rules_fired(findings)
+    # the ring itself is a sanctioned primitive: shipping too much is the
+    # budget rule's finding, not the PR-6 collective lint's
+    assert "collective-in-shard-map" not in _rules_fired(findings)
 
 
 def test_specs_for_experiment_fused_round_routes_to_fused_chunk():

@@ -241,6 +241,70 @@ def test_shard_pool_state_rejects_non_divisible():
     assert int(labeled_count(sh)) == 0  # padding rows don't count as labeled
 
 
+def test_shard_pool_state_per_shard_watermark_parity(devices):
+    """Sharding a scalar fill watermark yields the per-shard [S] leaf whose
+    masks are bit-identical to the scalar's, whose psum'd global view
+    (``filled_count``) equals the scalar, and which lands P(data) — the
+    pre-pod replication of ``n_filled`` is gone."""
+    from distributed_active_learning_tpu.parallel.mesh import (
+        shard_fill_watermark,
+    )
+    from distributed_active_learning_tpu.runtime.state import filled_count
+
+    x, y = make_checkerboard(jax.random.key(5), 256)
+    state = set_start_state(init_pool_state(x, y, jax.random.key(6)), 8)
+    scalar = state.replace(n_filled=jnp.asarray(37, jnp.int32))
+    mesh = make_mesh(data=4, model=2)
+    sh = shard_pool_state(scalar, mesh)
+
+    assert sh.n_filled.shape == (4,)
+    np.testing.assert_array_equal(np.asarray(sh.n_filled), [37, 0, 0, 0])
+    np.testing.assert_array_equal(
+        np.asarray(sh.n_filled), np.asarray(shard_fill_watermark(37, 256, 4))
+    )
+    assert int(filled_count(sh)) == 37 == int(filled_count(scalar))
+    for prop in ("fill_mask", "valid_mask", "unlabeled_mask"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(sh, prop)), np.asarray(getattr(scalar, prop))
+        )
+    assert int(labeled_count(sh)) == int(labeled_count(scalar))
+    # the leaf is sharded over data, one element per shard — not replicated
+    spec = sh.n_filled.sharding.spec
+    assert tuple(spec) == ("data",)
+
+    # a watermark past one block boundary splits across shards
+    np.testing.assert_array_equal(
+        np.asarray(shard_fill_watermark(150, 256, 4)), [64, 64, 22, 0]
+    )
+    # an already per-shard leaf of the wrong width is refused
+    bad = scalar.replace(n_filled=jnp.asarray([1, 2], jnp.int32))
+    with pytest.raises(ValueError, match="does not match"):
+        shard_pool_state(bad, mesh)
+
+
+def test_global_count_matches_filled_count(devices):
+    """The explicit shard_map psum spelling of the bookkeeping scalar moves
+    one int32 per shard and agrees with the host-side sum."""
+    from jax.sharding import PartitionSpec as SP
+
+    from distributed_active_learning_tpu.parallel.collectives import (
+        global_count,
+    )
+    from distributed_active_learning_tpu.utils.compat import shard_map
+
+    mesh = make_mesh(data=4, model=2)
+    mask = jnp.arange(64) % 3 == 0
+
+    def body(m_blk):
+        return global_count(m_blk, "data")[None]
+
+    out = shard_map(
+        body, mesh=mesh, in_specs=SP("data"), out_specs=SP("data"),
+        check_vma=False,
+    )(mask)
+    assert np.all(np.asarray(out) == int(mask.sum()))
+
+
 def test_mesh_model_axis_must_divide_trees():
     from distributed_active_learning_tpu.config import (
         DataConfig,

@@ -1,0 +1,229 @@
+"""Ring-merged top-k (ops/ring_topk.py): exactness and tie-break property
+tests against a host ``np.argsort`` reference — duplicate-heavy scores,
+``k`` larger than a shard's candidate count — plus parity of the distributed
+fused selection against the single-mesh global top-k it replaces.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from distributed_active_learning_tpu.ops import ring_topk as rt
+from distributed_active_learning_tpu.ops.topk import (
+    NEG_INF,
+    select_bottom_k,
+    select_top_k,
+)
+from distributed_active_learning_tpu.parallel import make_mesh
+from distributed_active_learning_tpu.utils.compat import shard_map
+
+
+def _np_topk(vals, idx, k):
+    """Host reference: top ``k`` of (vals, idx) pairs under the merge order
+    (value desc, index asc) — ``np.lexsort`` keys run last-primary."""
+    vals = np.asarray(vals, np.float32).ravel()
+    idx = np.asarray(idx, np.int64).ravel()
+    order = np.lexsort((idx, -vals))[:k]
+    return vals[order], idx[order]
+
+
+def _duplicate_heavy(rng, n):
+    """Scores drawn from a handful of levels: most rows tie with others."""
+    levels = np.array([-1.5, 0.0, 0.25, 0.25, 3.0], np.float32)
+    return levels[rng.integers(0, len(levels), size=n)]
+
+
+# ---------------------------------------------------------------------------
+# host-side window algebra (no mesh)
+# ---------------------------------------------------------------------------
+
+
+def test_pad_window_pads_and_truncates():
+    v = jnp.array([3.0, 1.0], jnp.float32)
+    i = jnp.array([4, 9], jnp.int32)
+    pv, pi = rt.pad_window(v, i, 5)
+    assert pv.shape == (5,) and pi.shape == (5,)
+    np.testing.assert_array_equal(np.asarray(pv[:2]), [3.0, 1.0])
+    assert np.all(np.asarray(pv[2:]) == NEG_INF)
+    assert np.all(np.asarray(pi[2:]) == rt.IDX_SENTINEL)
+    tv, ti = rt.pad_window(pv, pi, 2)  # k smaller: truncation, no padding
+    assert tv.shape == (2,) and list(np.asarray(ti)) == [4, 9]
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_merge_windows_matches_host_reference_under_ties(seed):
+    rng = np.random.default_rng(seed)
+    k = 6
+    a_v = _duplicate_heavy(rng, k)
+    b_v = _duplicate_heavy(rng, k)
+    a_i = rng.permutation(64)[:k].astype(np.int32)
+    b_i = (64 + rng.permutation(64)[:k]).astype(np.int32)
+    mv, mi = rt.merge_windows(
+        jnp.asarray(a_v), jnp.asarray(a_i), jnp.asarray(b_v), jnp.asarray(b_i), k
+    )
+    rv, ri = _np_topk(
+        np.concatenate([a_v, b_v]), np.concatenate([a_i, b_i]), k
+    )
+    np.testing.assert_array_equal(np.asarray(mv), rv)
+    np.testing.assert_array_equal(np.asarray(mi), ri)
+
+
+def test_merge_windows_padding_loses_all_ties():
+    """(-inf, IDX_SENTINEL) padding ranks strictly after every real row,
+    including real -inf (masked) rows — the sentinel-tail contract."""
+    k = 4
+    a_v, a_i = rt.pad_window(
+        jnp.array([2.0], jnp.float32), jnp.array([7], jnp.int32), k
+    )
+    b_v = jnp.array([NEG_INF, 2.0], jnp.float32)  # a real masked row ties -inf
+    b_i = jnp.array([3, 11], jnp.int32)
+    b_v, b_i = rt.pad_window(b_v, b_i, k)
+    mv, mi = rt.merge_windows(a_v, a_i, b_v, b_i, k)
+    assert list(np.asarray(mi)) == [7, 11, 3, rt.IDX_SENTINEL]
+    assert np.asarray(mv)[2] == NEG_INF
+
+
+# ---------------------------------------------------------------------------
+# the ring on a 4x2 mesh (8 virtual CPU devices; ppermute transport)
+# ---------------------------------------------------------------------------
+
+def _ring_merge_global(mesh, scores, sel, k):
+    """Run the production window pipeline under shard_map and return EVERY
+    shard's merged window ([S, k] each) so per-shard convergence is
+    observable — the replication the callers assert with out_specs=P()."""
+    S = mesh.shape["data"]
+    n_local = scores.shape[0] // S
+
+    def body(s_blk, m_blk):
+        kk = min(k, n_local)
+        work = jnp.where(m_blk, s_blk, NEG_INF)
+        loc_v, loc_i = lax.top_k(work, kk)
+        glob_i = (lax.axis_index("data") * n_local + loc_i).astype(jnp.int32)
+        win_v, win_i = rt.pad_window(loc_v, glob_i, k)
+        acc_v, acc_i = rt.ring_topk(
+            win_v, win_i, k, "data", mesh_axis_names=mesh.axis_names
+        )
+        return acc_v[None], acc_i[None]
+
+    return shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P("data"), P("data")),
+        out_specs=(P("data"), P("data")),
+        check_vma=False,
+    )(scores, sel)
+
+
+@pytest.mark.parametrize("k", [5, 16, 24])  # 24 > n_local=16: padded windows
+def test_ring_topk_matches_global_topk_with_duplicates(devices, k):
+    mesh = make_mesh(data=4, model=2)
+    rng = np.random.default_rng(7)
+    n = 64
+    scores = jnp.asarray(_duplicate_heavy(rng, n))
+    sel = jnp.asarray(rng.integers(0, 2, size=n).astype(bool))
+    all_v, all_i = _ring_merge_global(mesh, scores, sel, k)
+    all_v = np.asarray(all_v).reshape(4, k)
+    all_i = np.asarray(all_i).reshape(4, k)
+    # reference 1: lax.top_k over the full masked vector (value desc, pos asc)
+    ref_v, ref_i = lax.top_k(jnp.where(sel, scores, NEG_INF), k)
+    # reference 2: the host lexsort order over real candidates + sentinel tail
+    host = np.where(np.asarray(sel), np.asarray(scores), NEG_INF)
+    hv, hi = _np_topk(host, np.arange(n), k)
+    np.testing.assert_array_equal(np.asarray(ref_v), hv)
+    np.testing.assert_array_equal(np.asarray(ref_i), hi)
+    for s in range(4):  # every shard converges to the identical window
+        np.testing.assert_array_equal(all_v[s], np.asarray(ref_v))
+        np.testing.assert_array_equal(all_i[s], np.asarray(ref_i))
+
+
+def test_ring_topk_sentinel_tail_when_too_few_candidates(devices):
+    """k greater than the TOTAL candidate count: the merged tail must be
+    (-inf over masked rows by index, then sentinels) — byte-identical to
+    lax.top_k over the masked vector for the masked part."""
+    mesh = make_mesh(data=4, model=2)
+    n, k = 64, 8
+    scores = jnp.linspace(0.0, 1.0, n, dtype=jnp.float32)
+    sel = jnp.zeros((n,), bool).at[jnp.array([5, 40])].set(True)  # 2 real rows
+    all_v, all_i = _ring_merge_global(mesh, scores, sel, k)
+    v = np.asarray(all_v).reshape(4, k)[0]
+    i = np.asarray(all_i).reshape(4, k)[0]
+    assert list(i[:2]) == [40, 5]
+    assert np.all(v[2:] == NEG_INF)
+    # tail = each shard's lowest-index masked rows, merged by index — the
+    # same first masked positions lax.top_k's positional tie-break yields
+    ref_v, ref_i = lax.top_k(jnp.where(sel, scores, NEG_INF), k)
+    np.testing.assert_array_equal(i[2:], np.asarray(ref_i)[2:])
+    assert np.all(np.asarray(ref_v)[2:] == NEG_INF)
+
+
+def test_ring_topk_validates_window_shape(devices):
+    mesh = make_mesh(data=4, model=2)
+
+    def bad(s_blk):
+        return rt.ring_topk(s_blk, s_blk.astype(jnp.int32), 4, "data")[0]
+
+    with pytest.raises(ValueError, match="k-row windows"):
+        shard_map(
+            bad, mesh=mesh, in_specs=P("data"), out_specs=P("data"),
+            check_vma=False,
+        )(jnp.zeros((64,), jnp.float32))
+
+
+# ---------------------------------------------------------------------------
+# distributed fused selection == single-mesh global top-k
+# ---------------------------------------------------------------------------
+
+def _fitted_sharded_forest():
+    from test_round_fused import _fit_gemm
+    from distributed_active_learning_tpu.ops.trees_pallas import (
+        ShardedPallasForest,
+    )
+
+    gf, x, mask = _fit_gemm()
+    mesh = make_mesh(data=4, model=2)
+    return ShardedPallasForest(gf=gf, mesh=mesh), x, ~mask
+
+
+def _single_mesh_reference(f, x, sel, name, k):
+    """The path this PR replaces: psum'd global votes -> one full-pool
+    masked top-k on every device. Same vote source (the per-shard pallas
+    megakernel), so parity is bit-exact — scores, indices, tie-breaks."""
+    from distributed_active_learning_tpu.ops import round_fused
+
+    votes = round_fused._sharded_fused_votes(f, x)
+    p = votes.astype(jnp.float32) / f.n_trees
+    score_fn, higher = round_fused.FUSED_STRATEGIES[name]
+    scores = score_fn(p)
+    return (select_top_k if higher else select_bottom_k)(scores, sel, k)
+
+
+def test_pod_selection_bit_identical_to_single_mesh(devices):
+    # One strategy, one shape in tier 1 (each extra shape is another shard
+    # compile); the slow matrix below sweeps strategies and the short-pool
+    # k > n_local regime, and the synthetic ring tests above pin the
+    # window-algebra edge cases cheaply.
+    from distributed_active_learning_tpu.ops import round_fused
+
+    f, x, sel = _fitted_sharded_forest()
+    v, i = round_fused.fused_score_select(f, x, sel, "entropy", 7)
+    rv, ri = _single_mesh_reference(f, x, sel, "entropy", 7)
+    np.testing.assert_array_equal(np.asarray(v), np.asarray(rv))
+    np.testing.assert_array_equal(np.asarray(i), np.asarray(ri))
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "name", ["uncertainty", "margin", "full_entropy", "entropy"]
+)
+def test_pod_selection_bit_identical_all_strategies(devices, name):
+    from distributed_active_learning_tpu.ops import round_fused
+
+    f, x, sel = _fitted_sharded_forest()
+    for (xx, ss, k) in ((x, sel, 7), (x[:24], sel[:24], 24)):
+        v, i = round_fused.fused_score_select(f, xx, ss, name, k)
+        rv, ri = _single_mesh_reference(f, xx, ss, name, k)
+        np.testing.assert_array_equal(np.asarray(v), np.asarray(rv))
+        np.testing.assert_array_equal(np.asarray(i), np.asarray(ri))

@@ -321,6 +321,57 @@ def test_summarize_metrics_tables(tmp_path, capsys):
     assert "== counters ==" in out2 and "host_transfer_bytes" in out2
 
 
+def test_summarize_metrics_pod_selection_table(tmp_path, capsys):
+    """The "== pod selection ==" table renders one row per well-formed
+    pod_select event (sorted by shard count) and skips malformed events —
+    missing fields, non-numeric strings, bool-typed numbers — never crashing."""
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "benches"))
+    try:
+        import summarize_metrics
+    finally:
+        sys.path.pop(0)
+
+    path = str(tmp_path / "pod.jsonl")
+    events = [
+        {"kind": "pod_select", "shards": 4, "per_shard_rows": 512,
+         "per_shard_candidates": 100, "ring_hops": 3,
+         "select_seconds": 0.025, "points_per_second": 81920.0},
+        {"kind": "pod_select", "shards": 1, "per_shard_rows": 512,
+         "per_shard_candidates": 100, "ring_hops": 0,
+         "select_seconds": 0.0125, "points_per_second": 40960.0},
+        # malformed: missing shards / non-numeric wall / bool-typed shards
+        {"kind": "pod_select", "select_seconds": 0.5},
+        {"kind": "pod_select", "shards": 2, "select_seconds": "torn"},
+        {"kind": "pod_select", "shards": True, "select_seconds": 0.5},
+    ]
+    with open(path, "w") as fh:
+        for e in events:
+            fh.write(json.dumps(e) + "\n")
+
+    assert summarize_metrics.main([path]) == 0
+    out = capsys.readouterr().out
+    assert "== pod selection ==" in out
+    assert "ring hops" in out
+    pod_rows = [
+        l for l in out.splitlines()
+        if l.strip() and l.split()[0] in ("1", "4", "2", "True")
+    ]
+    assert len(pod_rows) == 2  # the two well-formed events, nothing else
+    assert pod_rows[0].split()[0] == "1"  # sorted by shard count
+    assert pod_rows[1].split()[0] == "4"
+    assert "81,920" in out and "0.0250" in out and "torn" not in out
+
+    # an all-malformed stream renders no pod table at all
+    path2 = str(tmp_path / "pod2.jsonl")
+    with open(path2, "w") as fh:
+        fh.write(json.dumps({"kind": "pod_select", "shards": "x"}) + "\n")
+    assert summarize_metrics.main([path2]) == 0
+    assert "== pod selection ==" not in capsys.readouterr().out
+
+
 def test_jit_cache_size_reports_growth():
     import jax
 
