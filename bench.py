@@ -541,6 +541,12 @@ def bench_round(args):
         result.get("recompiles_after_warmup"), int
     ):
         result["recompiles_after_warmup"] += pod_rc
+    result.update(_bench_pod_ingest(args, pool, pool_y, mask0, binned))
+    ingest_rc = result.get("pod_ingest_recompiles_after_warmup")
+    if isinstance(ingest_rc, int) and isinstance(
+        result.get("recompiles_after_warmup"), int
+    ):
+        result["recompiles_after_warmup"] += ingest_rc
     return result
 
 
@@ -813,6 +819,168 @@ def _bench_pod_select(args, pool, pool_y, mask0, binned):
                 ring_hops=S - 1,
                 select_seconds=round(legs[S]["seconds"], 6),
                 points_per_second=round(rows * S / legs[S]["seconds"], 1),
+            )
+        writer.close()
+    return out
+
+
+def _bench_pod_ingest(args, pool, pool_y, mask0, binned):
+    """Pod-scale sharded ingest (serving/slab.py ``make_sharded_ingest_fn``)
+    + one rebalance epoch: the data-path twin of the ``pod_select`` leg.
+    Each shard-count leg shards an ``S x 512``-row slab pool over a (S, 1)
+    mesh and times the one jitted donation-append launch (router-addressed,
+    shard-local write, psum'd global fill — the only collective); appends
+    are shard-local, so wall time should hold flat in the shard count. The
+    rebalance epoch runs once at the max shard count after deliberately
+    skewing one shard: its window-sized ``all_to_all`` is the only other
+    collective in the data path, and its launch time lands as a
+    ``rebalance`` event. CPU shards are XLA virtual host devices — a
+    scaling-structure and recompile surface (the smoke gate is
+    ``pod_ingest_recompiles_after_warmup == 0``), not an absolute-perf one.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_active_learning_tpu.parallel import make_mesh
+    from distributed_active_learning_tpu.runtime import telemetry
+    from distributed_active_learning_tpu.serving import slab
+
+    rows = 512
+    block = 64
+    n0 = 32
+    max_s = min(8, len(jax.devices()))
+    shard_counts = [s for s in (1, 2, 4, 8) if s <= max_s]
+    rng = np.random.default_rng(5)
+
+    def _block(i):
+        idx = (np.arange(block) + i * 79) % args.pool
+        bx = pool[idx]
+        by = rng.integers(0, 2, size=block).astype(np.int32)
+        return jnp.asarray(bx), jnp.asarray(by)
+
+    fns, pools, runs, legs = {}, {}, {}, {}
+    for S in shard_counts:
+        mesh = make_mesh(data=S, model=1, devices=jax.devices()[:S])
+        base = slab.init_slab_pool(
+            pool[:n0], pool_y[:n0], mask0[:n0], binned.edges,
+            slab_rows=rows * S,
+        )
+        pools[S] = slab.shard_slab_pool(base, mesh)
+        ingest = slab.make_sharded_ingest_fn(mesh)
+        fns[S] = ingest
+
+        def run(S=S, ingest=ingest):
+            p = pools[S]
+            fills = np.asarray(jax.device_get(p.n_filled))
+            shard = slab.route_to_shard(fills)
+            bx, by = _block(int(fills.sum()) // block)
+            p, gfill = ingest(p, binned.edges, bx, by, block, shard)
+            jax.block_until_ready(gfill)
+            pools[S] = p
+
+        runs[S] = run
+        _flight("bench_compile", label=f"round/pod_ingest/s{S}")
+        t0 = time.perf_counter()
+        run()  # compile
+        legs[S] = {"first_call": time.perf_counter() - t0}
+
+    # Interleaved reps, best-rep seconds per leg (the _bench_pod_select
+    # timing discipline). Each rep is a real append: the donated slab
+    # threads through `pools`, so no leg ever re-appends into a stale pool.
+    reps = 3
+    times = {S: [] for S in shard_counts}
+    _flight("bench_timing_start", label="round/pod_ingest/interleaved", iters=reps)
+    for _ in range(reps):
+        for S, run in runs.items():
+            t0 = time.perf_counter()
+            run()
+            times[S].append(time.perf_counter() - t0)
+    _flight(
+        "bench_timing_end", label="round/pod_ingest/interleaved",
+        seconds=round(sum(sum(t) for t in times.values()), 4),
+    )
+    for S in shard_counts:
+        legs[S]["seconds"] = min(times[S])
+        legs[S]["fills"] = np.asarray(jax.device_get(pools[S].n_filled))
+
+    # One rebalance epoch at the max shard count: skew one shard with two
+    # directly-addressed appends, then time the steady epoch launch (the
+    # second call — the first call pays the compile and does the moving).
+    s_max = shard_counts[-1]
+    rebalance_leg = None
+    if s_max > 1:
+        mesh = make_mesh(data=s_max, model=1, devices=jax.devices()[:s_max])
+        ingest = fns[s_max]
+        for i in range(2):
+            bx, by = _block(i)
+            p, gfill = ingest(
+                pools[s_max], binned.edges, bx, by, block, 0
+            )
+            jax.block_until_ready(gfill)
+            pools[s_max] = p
+        rebalance = slab.make_rebalance_fn(mesh, block_rows=block)
+        p, ms, md = rebalance(pools[s_max])  # compile + the moving epoch
+        jax.block_until_ready(ms)
+        t0 = time.perf_counter()
+        p, ms, md = rebalance(p)
+        jax.block_until_ready(ms)
+        rebalance_sec = time.perf_counter() - t0
+        pools[s_max] = p
+        fills = np.asarray(jax.device_get(p.n_filled))
+        rebalance_leg = {
+            "seconds": rebalance_sec,
+            "fill_max": int(fills.max()),
+            "fill_min": int(fills.min()),
+            "recompiles": max((telemetry.jit_cache_size(rebalance) or 1) - 1, 0),
+        }
+
+    recompiles = sum(
+        max((telemetry.jit_cache_size(fn) or 1) - 1, 0) for fn in fns.values()
+    )
+    if rebalance_leg is not None:
+        recompiles += rebalance_leg["recompiles"]
+    sec_max = legs[s_max]["seconds"]
+    out = {
+        "pod_ingest_shard_counts": shard_counts,
+        "pod_ingest_per_shard_rows": rows,
+        "pod_ingest_block_rows": block,
+        "pod_ingest_seconds_by_shards": {
+            str(S): round(legs[S]["seconds"], 4) for S in shard_counts
+        },
+        "pod_ingest_points_per_second": round(block / sec_max, 1),
+        # wall at max shards over wall at 1 shard: ~1.0 = flat scaling
+        "pod_ingest_flat_ratio": round(
+            sec_max / legs[shard_counts[0]]["seconds"], 3
+        ),
+        "pod_ingest_recompiles_after_warmup": recompiles,
+    }
+    if rebalance_leg is not None:
+        out["pod_rebalance_seconds"] = round(rebalance_leg["seconds"], 4)
+
+    metrics_out = getattr(args, "metrics_out", None)
+    if metrics_out:
+        writer = telemetry.MetricsWriter(metrics_out)
+        for S in shard_counts:
+            fills = legs[S]["fills"]
+            writer.event(
+                "pod_ingest",
+                shards=S,
+                per_shard_rows=rows,
+                block_rows=block,
+                ingest_seconds=round(legs[S]["seconds"], 6),
+                points_per_second=round(block / legs[S]["seconds"], 1),
+                fill_max=int(fills.max()),
+                fill_min=int(fills.min()),
+            )
+        if rebalance_leg is not None:
+            writer.event(
+                "rebalance",
+                shards=s_max,
+                per_shard_rows=rows,
+                block_rows=block,
+                rebalance_seconds=round(rebalance_leg["seconds"], 6),
+                fill_max=rebalance_leg["fill_max"],
+                fill_min=rebalance_leg["fill_min"],
             )
         writer.close()
     return out

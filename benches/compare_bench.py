@@ -71,6 +71,11 @@ DEFAULT_SPECS: List[MetricSpec] = [
     # wall ratio (t_maxS / t_1; interpret-mode CPU smoke is noisy — loose)
     MetricSpec("pod_select_points_per_second", "higher", 0.30),
     MetricSpec("pod_select_flat_ratio", "lower", 0.50),
+    # pod-sharded ingest (per-shard donation appends + the window-sized
+    # rebalance epoch): same flat-in-shard-count story as pod_select
+    MetricSpec("pod_ingest_points_per_second", "higher", 0.30),
+    MetricSpec("pod_ingest_flat_ratio", "lower", 0.50),
+    MetricSpec("pod_rebalance_seconds", "lower", 0.50),
     MetricSpec("pipelined_seconds_per_round", "lower", 0.30),
     MetricSpec("touchdown_hidden_fraction", "higher", 0.50),
     # sweep / grid / serve / lal / neural
@@ -111,6 +116,12 @@ DEFAULT_SPECS: List[MetricSpec] = [
     # across its interleaved shard-count reps is an architectural regression
     MetricSpec(
         "pod_recompiles_after_warmup", "lower", 0.0, kind="counter",
+        hard=True,
+    ),
+    # the sharded data-path twin: ingest closures and the rebalance epoch
+    # must hold one executable each across every shard-count leg
+    MetricSpec(
+        "pod_ingest_recompiles_after_warmup", "lower", 0.0, kind="counter",
         hard=True,
     ),
     # serve-multi's namespaced twin, plus the AOT-precompile acceptance gate:

@@ -564,38 +564,65 @@ def summarize(events: List[dict]) -> str:
             )
         )
 
-    # Pod-scale selection legs (bench.py --mode round --metrics-out): one
-    # event per shard count in the weak-scaling sweep. Per-shard candidate
-    # counts and ring hop counts make the collective geometry legible next
-    # to the merge wall time. Defensive like the serve tables: a malformed
-    # event (missing / non-numeric / bool-typed fields) is skipped.
+    # Pod-scale data-path legs (bench.py --mode round --metrics-out): one
+    # pod_select event per shard count in the weak-scaling selection sweep,
+    # plus the ingest sub-leg's pod_ingest events and its rebalance epochs.
+    # The window column is the leg's bounded exchange (candidate window for
+    # selection, block rows for ingest/rebalance) and the balance column is
+    # the max/min shard-fill ratio — the rebalance trigger's own statistic,
+    # so an epoch's effect is legible as balance dropping toward 1.00.
+    # Defensive like the serve tables: a malformed event (missing /
+    # non-numeric / bool-typed fields) is skipped.
+    _POD_SECONDS = {
+        "pod_select": "select_seconds",
+        "pod_ingest": "ingest_seconds",
+        "rebalance": "rebalance_seconds",
+    }
+    _POD_ORDER = {"pod_select": 0, "pod_ingest": 1, "rebalance": 2}
     pod_events = [
         e for e in events
-        if e.get("kind") == "pod_select"
+        if e.get("kind") in _POD_SECONDS
         and _num(e, "shards") is not None
-        and _num(e, "select_seconds") is not None
+        and _num(e, _POD_SECONDS[e["kind"]]) is not None
     ]
     if pod_events:
         rows = []
-        for e in sorted(pod_events, key=lambda e: e["shards"]):
+        for e in sorted(
+            pod_events, key=lambda e: (e["shards"], _POD_ORDER[e["kind"]])
+        ):
             def _i(key):
                 v = _num(e, key)
                 return int(v) if v is not None else "-"
 
+            def _balance():
+                hi, lo = _num(e, "fill_max"), _num(e, "fill_min")
+                if hi is None or lo is None:
+                    return "-"
+                if lo <= 0:
+                    return "inf" if hi > 0 else "1.00"
+                return f"{hi / lo:.2f}"
+
+            kind = e["kind"]
             pps = _num(e, "points_per_second")
+            window = (
+                _i("per_shard_candidates") if kind == "pod_select"
+                else _i("block_rows")
+            )
             rows.append([
+                kind,
                 int(e["shards"]),
                 _i("per_shard_rows"),
-                _i("per_shard_candidates"),
-                _i("ring_hops"),
-                f"{e['select_seconds']:.4f}",
+                window,
+                _i("ring_hops") if kind == "pod_select" else "-",
+                f"{e[_POD_SECONDS[kind]]:.4f}",
                 f"{pps:,.0f}" if pps is not None else "-",
+                _balance(),
             ])
         out.append(
             "\n== pod selection ==\n"
             + _table(
-                ["shards", "per-shard rows", "per-shard candidates",
-                 "ring hops", "select s", "points/s"],
+                ["kind", "shards", "per-shard rows", "window",
+                 "ring hops", "seconds", "points/s", "balance"],
                 rows,
             )
         )

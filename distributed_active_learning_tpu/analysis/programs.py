@@ -74,7 +74,8 @@ LABEL_CAP = 40
 FIT_BUDGET = 48
 
 KINDS = (
-    "chunk", "fused_chunk", "fused_select", "pod_select", "sweep", "grid",
+    "chunk", "fused_chunk", "fused_select", "pod_select", "pod_ingest",
+    "sweep", "grid",
     "neural_sweep", "neural_chunk", "serve", "serve_multi", "scenario",
 )
 GRID_D = 2   # datasets in the audited grid program
@@ -444,6 +445,83 @@ def _build_pod_select(
         pool_rows=POOL_ROWS,
         pallas_tiles=_pallas_tiles(mesh_shape=mesh_shape),
     )
+
+
+def _build_pod_ingest(
+    program: str, placement: str, mesh_shape=MESH_SHAPE
+) -> AuditUnit:
+    """The POD-SHARDED data-path programs (serving/slab.py): ``append`` —
+    the per-shard donation ingest (each shard writes at its OWN watermark
+    inside one shard_map; the only collective is the psum'd global-fill
+    scalar) — and ``rebalance`` — the fill-rebalancing epoch (all-gathered
+    ``[S]`` fills + ONE window-sized all_to_all of row blocks). Mesh-only
+    like ``pod_select`` (the cpu spelling is the ``serve/ingest`` kind), and
+    the exact surface the PR-13 collective rules gate: a pool-scale
+    ``all_to_all`` here trips ``collective-bytes-over-budget`` (pinned by
+    tests/test_analysis.py's seeded over-budget fixture)."""
+    from distributed_active_learning_tpu.serving import slab as slab_lib
+
+    if placement == "cpu":
+        raise SkipProgram(
+            "pod ingest/rebalance are the sharded spellings of the slab "
+            "data path (the cpu spelling is the serve/ingest kind); no cpu "
+            "placement"
+        )
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    mesh = _mesh_or_skip(mesh_shape)
+    n_shards = mesh_shape[0]
+    # The abstract slab carries the canonical P("data") placement that
+    # shard_slab_pool commits — the factories pin their outputs to it
+    # (out_shardings), and the donation rule can only see the aliasing if
+    # the abstract inputs are sharded the way real pools are.
+    data_sh = NamedSharding(mesh, PartitionSpec("data"))
+
+    def _pod_sds(shape, dtype):
+        return jax.ShapeDtypeStruct(shape, dtype, sharding=data_sh)
+
+    slab = slab_lib.SlabPool(
+        x=_pod_sds((POOL_ROWS, FEATURES), jnp.float32),
+        oracle_y=_pod_sds((POOL_ROWS,), jnp.int32),
+        labeled_mask=_pod_sds((POOL_ROWS,), jnp.bool_),
+        codes=_pod_sds((POOL_ROWS, FEATURES), jnp.int32),
+        n_filled=_pod_sds((n_shards,), jnp.int32),   # the per-shard [S] leaf
+        slab_rows=POOL_ROWS // n_shards,
+    )
+    if program == "append":
+        args = (
+            slab,                                         # donated slab carry
+            _sds((FEATURES, MAX_BINS - 1), jnp.float32),  # bin edges
+            _sds((SERVE_BLOCK, FEATURES), jnp.float32),   # block_x
+            _sds((SERVE_BLOCK,), jnp.int32),              # block_y
+            _sds((), jnp.int32),                          # count
+            _sds((), jnp.int32),                          # routed shard
+        )
+        return AuditUnit(
+            name=f"pod_ingest/{program}/{placement}",
+            fn=slab_lib.make_sharded_ingest_fn(mesh),
+            args=args,
+            expect_donation=True,
+            carry_in_argnums=(0,),
+            carry_out_index=0,
+            pool_rows=POOL_ROWS,
+        )
+    if program == "rebalance":
+        return AuditUnit(
+            name=f"pod_ingest/{program}/{placement}",
+            fn=slab_lib.make_rebalance_fn(mesh, block_rows=SERVE_BLOCK),
+            args=(slab,),
+            expect_donation=True,
+            carry_in_argnums=(0,),
+            carry_out_index=0,
+            pool_rows=POOL_ROWS,
+        )
+    raise ValueError(f"unknown pod_ingest program {program!r}")
+
+
+def pod_ingest_names() -> List[str]:
+    """The pod data-path axis: the per-shard append and the rebalance epoch."""
+    return ["append", "rebalance"]
 
 
 def pod_select_names() -> List[str]:
@@ -1095,6 +1173,9 @@ def build_registry(
         # + ring-merged top-k): mesh-only — the placement where its
         # collective/sharding contract exists at all
         ("pod_select", _build_pod_select, pod_select_names()),
+        # the pod-sharded DATA PATH (per-shard ingest + the rebalance
+        # epoch's window-sized all_to_all): mesh-only for the same reason
+        ("pod_ingest", _build_pod_ingest, pod_ingest_names()),
         ("sweep", _build_sweep, forest_strategy_names()),
         # one fixed heterogeneous group set: the grid program's novelty is
         # the multi-strategy merge itself, not per-strategy variants (each
@@ -1118,13 +1199,13 @@ def build_registry(
         # the neural loop and the single-tenant serving programs have a
         # single (cpu) placement — emit it only when cpu was requested, so a
         # mesh-only filter doesn't smuggle cpu programs back into the audit;
-        # pod_select is the inverse (mesh placements only)
+        # pod_select/pod_ingest are the inverse (mesh placements only)
         if kind in (
             "neural_sweep", "neural_chunk", "serve", "fused_select",
             "scenario",
         ):
             kind_placements = ("cpu",) if "cpu" in placements else ()
-        elif kind == "pod_select":
+        elif kind in ("pod_select", "pod_ingest"):
             kind_placements = tuple(p for p in placements if p != "cpu")
         else:
             kind_placements = placements

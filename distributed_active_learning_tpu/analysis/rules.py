@@ -334,19 +334,37 @@ def _rule_donation(unit) -> Iterator[Finding]:
     "error",
     "no all_gather/all_to_all inside shard_map'd forest ops (psum/ppermute "
     "are the sanctioned collectives); a gather rematerializes a full mesh "
-    "axis per shard",
+    "axis per shard. Units that declare pool_rows narrow the lint to "
+    "pool-sized operands/results: the rebalance epoch's WINDOW-sized "
+    "all_to_all row exchange is sanctioned there, priced by the bytes "
+    "budget instead",
 )
 def _rule_shard_map_collectives(unit) -> Iterator[Finding]:
+    pool_rows = getattr(unit, "pool_rows", None)
     for site in unit.eqn_sites:
         if not site.in_shard_map:
             continue
         name = site.eqn.primitive.name
-        if name in SHARD_MAP_FLAGGED_COLLECTIVES:
-            yield _finding(
-                "collective-in-shard-map", unit, site.location,
-                f"{name} inside a shard_map region rematerializes the "
-                "sharded axis on every shard",
-            )
+        if name not in SHARD_MAP_FLAGGED_COLLECTIVES:
+            continue
+        if pool_rows:
+            # pool-aware units (serve/pod programs): a bounded window
+            # exchange is the rebalance contract — only a pool-scale
+            # gather/exchange is the bandwidth cliff this rule names.
+            # (Outputs count too: all_gather's cliff is its RESULT.)
+            avals = [
+                getattr(v, "aval", None)
+                for v in list(site.eqn.invars) + list(site.eqn.outvars)
+            ]
+            if not any(
+                a is not None and _has_pool_dim(a, pool_rows) for a in avals
+            ):
+                continue
+        yield _finding(
+            "collective-in-shard-map", unit, site.location,
+            f"{name} inside a shard_map region rematerializes the "
+            "sharded axis on every shard",
+        )
 
 
 # ---------------------------------------------------------------------------

@@ -251,3 +251,27 @@ def ring_topk(
         0, n_shards - 1, body, (vals, idx, vals, idx)
     )
     return acc_v, acc_i
+
+
+def remap_indices(
+    idx: jnp.ndarray, moved_src: jnp.ndarray, moved_dst: jnp.ndarray
+) -> jnp.ndarray:
+    """Map selection indices from a rebalanced pool back to pre-epoch rows.
+
+    A rebalance epoch (serving/slab.py ``make_rebalance_fn``) permutes a
+    window-sized set of rows and returns the permutation as ``(moved_src,
+    moved_dst)`` global-index pairs (negative entries are padding). The
+    ring merge's exactness argument needs only contiguous-block index
+    recovery — each candidate's global index names a unique resident row —
+    so a selection over the rebalanced pool recovers pre-epoch row
+    identities by rewriting every picked index that appears in
+    ``moved_dst`` with its ``moved_src`` twin; unmoved picks pass through.
+    O(k * moved) equality compare, window-sized on both axes — never a
+    pool-scale lookup table.
+    """
+    src = jnp.asarray(moved_src).reshape(-1)
+    dst = jnp.asarray(moved_dst).reshape(-1)
+    hit = (jnp.asarray(idx)[..., None] == dst[None, :]) & (dst[None, :] >= 0)
+    found = jnp.any(hit, axis=-1)
+    at = jnp.argmax(hit, axis=-1)
+    return jnp.where(found, src[at], idx)

@@ -31,6 +31,8 @@ from distributed_active_learning_tpu.parallel.kernels import (
 from distributed_active_learning_tpu.parallel.collectives import (
     vector_accumulate,
     masked_mean,
+    gather_fills,
+    exchange_blocks,
 )
 from distributed_active_learning_tpu.parallel.multihost import (
     maybe_initialize,

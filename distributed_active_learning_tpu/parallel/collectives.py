@@ -34,6 +34,29 @@ def global_count(local_mask: jnp.ndarray, axis_name: str) -> jnp.ndarray:
     return lax.psum(jnp.sum(local_mask.astype(jnp.int32)), axis_name)
 
 
+def gather_fills(local_fill: jnp.ndarray, axis_name: str) -> jnp.ndarray:
+    """All shards' fill watermarks as a replicated ``[S]`` vector.
+
+    One scalar per shard over ICI — the rebalance planner's only global
+    input. Every shard computes the identical plan from this vector, so the
+    exchange below needs no further coordination round.
+    """
+    return lax.all_gather(jnp.asarray(local_fill, jnp.int32), axis_name)
+
+
+def exchange_blocks(block: jnp.ndarray, axis_name: str) -> jnp.ndarray:
+    """Window-sized all-to-all of per-target row blocks.
+
+    ``block`` is ``[S, b, ...]``: slot ``j`` is what this shard sends to
+    shard ``j``; the result's slot ``i`` is what shard ``i`` sent here. This
+    is the rebalance epoch's ONE bulk collective, and ``b`` is capped at the
+    epoch's window-sized block — per-launch traffic is ``S * b`` rows
+    regardless of pool scale, which is what keeps the audited program under
+    the PR-13 ``collective-bytes-over-budget`` rule.
+    """
+    return lax.all_to_all(block, axis_name, split_axis=0, concat_axis=0, tiled=True)
+
+
 def masked_mean(values: jnp.ndarray, mask: jnp.ndarray, axis_name: str) -> jnp.ndarray:
     """Global mean of ``values`` where ``mask`` is set, across shards.
 

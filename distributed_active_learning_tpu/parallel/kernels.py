@@ -139,6 +139,7 @@ def make_sharded_round_fn(
     with_metrics: bool = False,
     n_classes: int = 2,
     fused: bool = False,
+    scenario=None,
 ):
     """The full AL round over a device mesh (GSPMD style).
 
@@ -150,13 +151,17 @@ def make_sharded_round_fn(
     passes through to :func:`runtime.loop.make_round_fn`: the in-scan
     :class:`~runtime.telemetry.RoundMetrics` reductions are plain jnp ops, so
     GSPMD partitions them with the round — metrics under a mesh match the
-    single-device values the same way accuracies do.
+    single-device values the same way accuracies do. ``scenario`` likewise
+    rides through: the only mesh-admitted kind (``noisy_oracle``,
+    runtime/loop.py's refusal gate) perturbs the round via a window-sized
+    abstain draw from the replicated round key, so GSPMD partitions the
+    scenario round exactly like the clean one.
     """
     from distributed_active_learning_tpu.runtime.loop import make_round_fn
 
     round_fn = make_round_fn(
         strategy, window_size, with_metrics=with_metrics, n_classes=n_classes,
-        fused=fused,
+        fused=fused, scenario=scenario,
     )
 
     def sharded_round(forest: PackedForest, state: PoolState, aux: StrategyAux):

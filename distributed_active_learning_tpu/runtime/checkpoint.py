@@ -605,9 +605,20 @@ def save_serve(
     result: ExperimentResult,
     fingerprint: Optional[str] = None,
     tenant: Optional[str] = None,
+    edges: Optional[np.ndarray] = None,
+    edges_epoch: Optional[int] = None,
 ) -> Optional[str]:
     """Streaming-service checkpoint: slab fill watermark + mask + ingested
     points + the resident fitted forest.
+
+    ``edges``/``edges_epoch`` persist the service's LIVE bin-refresh state
+    (serving/tenants.py ``_refresh_bins``): a drifting service re-quantizes
+    its slab against refreshed edges at runtime, and a restore that re-binned
+    from cold-start edges would hand the restored forest codes it was never
+    fitted on. Both ride under the same fingerprint guard as the rest of the
+    payload; ``None`` (a pre-refresh service, or an old caller) simply omits
+    the leaves and restores report ``(None, 0)`` — old checkpoints stay
+    restorable.
 
     Unlike the batch formats, the pool FEATURES are stored (sliced to the
     fill watermark): a service's pool is not reproducible from the dataset
@@ -668,6 +679,16 @@ def save_serve(
     }
     for i, leaf in enumerate(jax.tree_util.tree_leaves(forest)):
         payload[f"forest_leaf_{i}"] = np.asarray(leaf)
+    if edges is not None:
+        payload["bin_edges"] = np.asarray(edges, dtype=np.float32)
+        payload["edges_epoch"] = np.asarray(
+            0 if edges_epoch is None else int(edges_epoch), dtype=np.int32
+        )
+    elif edges_epoch:
+        raise ValueError(
+            f"save_serve got edges_epoch={edges_epoch} without the edges "
+            "array; a restore could not re-code the slab from an epoch alone"
+        )
     if fingerprint is not None:
         payload["config_fingerprint"] = np.frombuffer(
             fingerprint.encode(), dtype=np.uint8
@@ -708,13 +729,19 @@ def restore_latest_serve(
     """Load the newest service checkpoint; ``None`` if none exists.
 
     Returns ``(x, y, labeled_mask, n_filled, key_data, round, forest,
-    result)`` — host arrays plus the forest rebuilt against
+    result, edges, edges_epoch)`` — host arrays plus the forest rebuilt
+    against
     ``forest_template`` (the pytree ``jax.eval_shape`` of the service's own
     fit program produces; leaf count/shape mismatches mean a differently-
     configured forest and raise rather than resume garbage). A fingerprint
     mismatch raises, as in :func:`restore_latest`. ``tenant`` selects that
     tenant's file series (see :func:`save_serve`); the id stored in the
     payload must match, so a renamed file cannot cross-wire tenants.
+
+    ``edges``/``edges_epoch`` are the persisted bin-refresh state —
+    ``(None, 0)`` for checkpoints written before the refresh state rode
+    along (or by a service that never refreshed): the restoring service then
+    falls back to its cold-start edges, exactly the pre-PR behavior.
     """
     step = latest_serve_step(ckpt_dir, tenant=tenant)
     if step is None:
@@ -750,6 +777,10 @@ def restore_latest_serve(
         n_filled = int(z["n_filled"])
         key_data = z["key"]
         rnd = z["round"]
+        edges = z["bin_edges"] if "bin_edges" in z.files else None
+        edges_epoch = (
+            int(z["edges_epoch"]) if "edges_epoch" in z.files else 0
+        )
         records = json.loads(bytes(z["records_json"]).decode())
         leaves, treedef = jax.tree_util.tree_flatten(forest_template)
         stored = sorted(
@@ -784,7 +815,7 @@ def restore_latest_serve(
         records=[RoundRecord(**{k: v for k, v in r.items() if k in known})
                  for r in records]
     )
-    return x, y, mask, n_filled, key_data, rnd, forest, result
+    return x, y, mask, n_filled, key_data, rnd, forest, result, edges, edges_epoch
 
 
 def save_neural(

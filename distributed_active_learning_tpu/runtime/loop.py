@@ -843,11 +843,20 @@ def run_experiment(
                 f"scenario {scn.kind!r} runs inside the jitted round and "
                 "needs the device fit; use --fit device"
             )
-        if cfg.mesh.data * cfg.mesh.model > 1:
+        if cfg.mesh.data * cfg.mesh.model > 1 and scn.kind != "noisy_oracle":
+            # noisy_oracle rides the mesh: flips are applied to the oracle
+            # labels HERE, before shard_pool_state places them (so shards
+            # carry pre-flipped blocks), and the abstaining reveal's draw is
+            # a window-sized function of the replicated round key
+            # (scenarios/engine.py abstain_draw + the per-shard reveal
+            # spelling runtime/state.py reveal_masked_local), so GSPMD
+            # partitions the scenario round like the clean one. The other
+            # kinds still need single-device plumbing (knapsack selection,
+            # drift's eval transform, rare-recall metrics).
             raise ValueError(
-                f"scenario {scn.kind!r} is single-device for now (the "
-                "sharded scenario round rides the pod-sharding ROADMAP "
-                "item); drop --mesh-data/--mesh-model"
+                f"scenario {scn.kind!r} is single-device for now (only "
+                "noisy_oracle rides the pod mesh); drop "
+                "--mesh-data/--mesh-model"
             )
         if scn.kind == "noisy_oracle" and scn.flip_prob > 0.0:
             flips = scn_engine.flip_mask(scn, cfg.seed, state.n_pool)
@@ -886,7 +895,7 @@ def run_experiment(
         round_fn = make_sharded_round_fn(
             strategy, cfg.strategy.window_size, mesh,
             with_metrics=want_metrics, n_classes=n_classes,
-            fused=cfg.fused_round,
+            fused=cfg.fused_round, scenario=scn,
         )
         if cfg.forest.kernel == "pallas":
             # pallas_call has no GSPMD partitioning rule, so the fused kernel

@@ -281,3 +281,39 @@ def reveal_masked(
         keep = keep & (draw >= abstain_prob)
     mask = state.labeled_mask.at[picked_idx].max(keep)
     return state.replace(labeled_mask=mask, round=state.round + 1)
+
+
+def reveal_masked_local(
+    mask_block: jnp.ndarray,
+    picked_idx: jnp.ndarray,
+    keep: jnp.ndarray,
+    shard_index: jnp.ndarray,
+    rows: int,
+    *,
+    abstain_key: Optional[jax.Array] = None,
+    abstain_prob: float = 0.0,
+) -> jnp.ndarray:
+    """Shard-local spelling of :func:`reveal_masked` for the pod-sharded pool.
+
+    Call INSIDE a ``shard_map`` body: ``mask_block [rows]`` is this shard's
+    contiguous mask block, ``picked_idx`` the window of GLOBAL indices
+    (replicated — the ring-merged selection's ``out_specs=P()`` output), and
+    ``shard_index`` the shard's data-axis index. Each shard keeps only the
+    picks landing in its own block ``[shard_index * rows, (shard_index + 1)
+    * rows)`` and scatters into LOCAL positions — zero collectives, the
+    reveal's traffic is the already-replicated window.
+
+    The abstain draw runs on every shard from the same replicated
+    ``abstain_key`` over the same window shape, so per-shard draws are
+    bit-identical to the global spelling's single draw — concatenating the S
+    shard blocks reproduces :func:`reveal_masked`'s mask exactly (pinned by
+    the pod-pool parity tests). Foreign picks redirect to local row 0 with
+    ``keep=False``; ``.max(False)`` writes nothing.
+    """
+    if abstain_key is not None:
+        draw = jax.random.uniform(abstain_key, picked_idx.shape)
+        keep = keep & (draw >= abstain_prob)
+    local = picked_idx - shard_index * rows
+    mine = (local >= 0) & (local < rows)
+    safe = jnp.clip(local, 0, rows - 1)
+    return mask_block.at[safe].max(keep & mine)

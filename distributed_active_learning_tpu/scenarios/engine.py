@@ -132,6 +132,45 @@ def flip_mask(scn: ScenarioConfig, cell_seed: int, n: int) -> jnp.ndarray:
     return jax.random.uniform(key, (n,)) < scn.flip_prob
 
 
+def flip_mask_block(
+    scn: ScenarioConfig,
+    cell_seed: int,
+    n_pool: int,
+    shard_index: jnp.ndarray,
+    rows: int,
+) -> jnp.ndarray:
+    """Shard-local view of :func:`flip_mask`: the ``[rows]`` slice owned by
+    the shard at data-axis index ``shard_index`` (contiguous block
+    ``[shard_index * rows, (shard_index + 1) * rows)``).
+
+    Keyed by GLOBAL row index: each shard draws the full ``[n_pool]``
+    bernoulli vector locally (pure compute, ZERO collectives — the draw is a
+    counter-based function of the scenario key, identical on every shard)
+    and slices its own rows, so the per-shard masks concatenate to the
+    single-device :func:`flip_mask` bit-for-bit at any shard count. Flips
+    run once per experiment at setup, so the pool-scale local draw is a
+    one-time cost, never a per-round one.
+    """
+    full = flip_mask(scn, cell_seed, n_pool)
+    start = jnp.asarray(shard_index, jnp.int32) * rows
+    return jax.lax.dynamic_slice(full, (start,), (rows,))
+
+
+def abstain_draw(scn: ScenarioConfig, abstain_key, shape) -> jnp.ndarray:
+    """The noisy oracle's keep-draw for a pick window: True where the oracle
+    ANSWERS (probability ``1 - abstain_prob``).
+
+    One spelling for the single-device reveal and the per-shard reveal
+    (``runtime.state.reveal_masked_local``): the draw depends only on the
+    replicated round key and the window shape, so every shard of a pod mesh
+    computes the identical window-sized vector — the reveal scatter stays
+    shard-local with no coordination. All-True for non-abstaining scenarios.
+    """
+    if scn.kind != "noisy_oracle" or scn.abstain_prob <= 0.0:
+        return jnp.ones(shape, dtype=bool)
+    return jax.random.uniform(abstain_key, shape) >= scn.abstain_prob
+
+
 def apply_flips(oracle_y: jnp.ndarray, flips: jnp.ndarray, n_classes: int) -> jnp.ndarray:
     """Oracle labels with the flip mask applied (traced or host).
 

@@ -193,6 +193,409 @@ def make_ingest_fn():
     return ingest
 
 
+# --------------------------------------------------------------------------
+# Pod-sharded slab pool: shard-local ingest + rebalancing epochs.
+#
+# The single-slab spelling above funnels every arrival through one host's
+# slab. At pod scale the pool lives as S contiguous row blocks on the mesh's
+# ``data`` axis (parallel/mesh.py), ``n_filled`` is the per-shard ``[S]``
+# watermark leaf, and the data path stays shard-local:
+#
+# - **Ingest** writes each arriving block at ONE shard's own watermark inside
+#   a single shard_map — the non-addressed shards run the same program as a
+#   window-sized identity rewrite, so there is one executable per capacity
+#   and zero collectives beyond the psum'd global-fill scalar. A host-side
+#   router (:func:`route_to_shard`) points arrivals at the least-filled
+#   shard.
+#
+# - **Rebalance** restores fill balance after skewed labeling/ingest with ONE
+#   window-sized ``all_to_all`` per epoch (never pool-scale — the PR-13
+#   ``collective-bytes-over-budget`` auditor is the contract, enforced on the
+#   registered ``pod_ingest`` programs). Donors ship their topmost filled
+#   rows; receivers append at their watermark; the permutation returns as a
+#   small global-index map so selection indices remain recoverable
+#   (``ops/ring_topk.remap_indices``).
+#
+# Global row identity is positional: ``global_idx = shard * rows + local``
+# with ``rows = capacity // S``. Growth (:func:`grow_sharded_slab`) pads each
+# shard's block in place, so it RENUMBERS global indices — callers treat
+# indices as valid only between shape changes (the single-slab pool has the
+# same property: its indices are stable only because it never re-chunks).
+# --------------------------------------------------------------------------
+
+#: Invalid-slot marker in rebalance index maps (valid global indices are >= 0).
+MOVED_SENTINEL = -1
+
+
+def shard_slab_pool(pool: SlabPool, mesh) -> SlabPool:
+    """Place a slab pool over ``mesh``'s data axis with a per-shard watermark.
+
+    A scalar ``n_filled`` is split with
+    :func:`parallel.mesh.shard_fill_watermark` (a single-slab pool fills
+    contiguously, so the split is exact); an already per-shard ``[S]`` leaf is
+    validated and re-placed as-is. Capacity must divide by the data axis —
+    each shard owns the contiguous block ``[s * rows, (s + 1) * rows)``.
+    """
+    from distributed_active_learning_tpu.parallel import mesh as mesh_lib
+    from jax.sharding import PartitionSpec as P
+
+    n_shards = mesh.shape[mesh_lib.AXIS_DATA]
+    if pool.capacity % n_shards:
+        raise ValueError(
+            f"slab capacity {pool.capacity} not divisible by data axis "
+            f"{n_shards}"
+        )
+    nf = jnp.asarray(pool.n_filled)
+    if nf.ndim == 0:
+        nf = mesh_lib.shard_fill_watermark(nf, pool.capacity, n_shards)
+    elif nf.shape != (n_shards,):
+        raise ValueError(
+            f"per-shard n_filled leaf {nf.shape} does not match the data "
+            f"axis ({n_shards} shards)"
+        )
+    # Every leaf rides the ONE canonical spec P("data") — rank-2 leaves
+    # shard dim 0 and replicate the rest, exactly pool_spec()'s meaning.
+    # The ingest/rebalance factories pin their outputs to the same spec
+    # (out_shardings), so the donated pool round-trips with an identical
+    # cache key on every mesh width; a spelling mismatch (P("data", None)
+    # in, P("data") out) would cost one silent recompile per closure.
+    spec = P(mesh_lib.AXIS_DATA)
+    return pool.replace(
+        x=mesh_lib.global_put(pool.x, mesh, spec),
+        oracle_y=mesh_lib.global_put(pool.oracle_y, mesh, spec),
+        labeled_mask=mesh_lib.global_put(pool.labeled_mask, mesh, spec),
+        codes=mesh_lib.global_put(pool.codes, mesh, spec),
+        n_filled=mesh_lib.global_put(nf, mesh, spec),
+    )
+
+
+def route_to_shard(fills) -> int:
+    """The ingest router: the least-filled shard's index (ties to the lowest).
+
+    Host-side and O(S) — routing consults only the ``[S]`` watermark vector
+    (S ints fetched per arrival batch at most), never the pool.
+    """
+    return int(np.argmin(np.asarray(fills)))
+
+
+def make_sharded_ingest_fn(mesh):
+    """Build the jitted per-shard donation-append program.
+
+    ``ingest(pool, edges, block_x, block_y, count, shard) -> (pool, global_fill)``
+    is the sharded spelling of :func:`make_ingest_fn`: one shard_map over the
+    mesh in which the shard addressed by ``shard`` (a traced scalar — the
+    router's pick) writes the block at its OWN watermark and advances it by
+    ``count``; every other shard executes the identical program as a
+    window-sized read-modify-write of rows it already owns (a slice re-write
+    of unchanged content), so the pool never materializes on one host and the
+    executable is shard-choice-independent. ``global_fill`` is the psum'd
+    post-ingest total (``parallel.collectives.global_count`` discipline) —
+    budget/stop bookkeeping stays exact without fetching the ``[S]`` leaf.
+
+    Same per-capacity compile contract as the single-slab factory: each call
+    returns a FRESH closure, one executable per capacity ever reached, growth
+    is the only loud recompile. The caller must guarantee the addressed shard
+    has room (``fills[shard] + block_rows <= capacity // S`` — grow first);
+    ``dynamic_update_slice`` would otherwise clamp and overwrite the newest
+    rows, exactly like the single-slab contract.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from distributed_active_learning_tpu.ops import trees_train
+    from distributed_active_learning_tpu.parallel import mesh as mesh_lib
+    from distributed_active_learning_tpu.utils.compat import shard_map
+
+    data = mesh_lib.AXIS_DATA
+
+    def _body(x_blk, y_blk, c_blk, nf, edges, block_x, block_y, count, shard):
+        me = jax.lax.axis_index(data)
+        fill = nf[0]
+        mine = me == shard
+        block_codes = trees_train.code_features(block_x, edges)
+        b, d = block_x.shape
+        # Window-sized conditional write: non-addressed shards slice their
+        # own rows at the watermark and write them back unchanged — same
+        # program on every shard, no gather of the pool anywhere. Full
+        # shards clamp the slice start; the write-back is then an identity
+        # on existing rows, still content-preserving.
+        cur_x = jax.lax.dynamic_slice(x_blk, (fill, 0), (b, d))
+        cur_y = jax.lax.dynamic_slice(y_blk, (fill,), (b,))
+        cur_c = jax.lax.dynamic_slice(c_blk, (fill, 0), (b, c_blk.shape[1]))
+        x_out = jax.lax.dynamic_update_slice(
+            x_blk, jnp.where(mine, block_x, cur_x), (fill, 0)
+        )
+        y_out = jax.lax.dynamic_update_slice(
+            y_blk, jnp.where(mine, block_y, cur_y), (fill,)
+        )
+        c_out = jax.lax.dynamic_update_slice(
+            c_blk, jnp.where(mine, block_codes, cur_c), (fill, 0)
+        )
+        nf_out = nf + jnp.where(mine, count, 0).astype(nf.dtype)
+        global_fill = jax.lax.psum(nf_out[0], data)
+        return x_out, y_out, c_out, nf_out, global_fill
+
+    sharded = shard_map(
+        _body,
+        mesh=mesh,
+        in_specs=(
+            P(data, None), P(data), P(data, None), P(data),
+            P(), P(), P(), P(), P(),
+        ),
+        out_specs=(P(data, None), P(data), P(data, None), P(data), P()),
+        check_vma=False,
+    )
+
+    # Pin the output pool to the input's named placement. On a 1-wide data
+    # axis GSPMD normalizes P("data") to P() (they are equivalent), so the
+    # returned watermark leaf would otherwise come back replicated and the
+    # NEXT donation-append call would miss the executable cache — one silent
+    # extra compile per 1-device-mesh closure, the exact cliff the hard-zero
+    # recompile gates exist to catch.
+    out_shardings = (
+        jax.sharding.NamedSharding(mesh, P(data)),
+        jax.sharding.NamedSharding(mesh, P()),
+    )
+
+    @functools.partial(
+        jax.jit, donate_argnums=(0,), out_shardings=out_shardings
+    )
+    def ingest(
+        pool: SlabPool,
+        edges: jnp.ndarray,
+        block_x: jnp.ndarray,
+        block_y: jnp.ndarray,
+        count: jnp.ndarray,
+        shard: jnp.ndarray,
+    ) -> Tuple[SlabPool, jnp.ndarray]:
+        with jax.named_scope("serve/pod_ingest"):
+            x, y, codes, nf, global_fill = sharded(
+                pool.x, pool.oracle_y, pool.codes, pool.n_filled,
+                edges, block_x, block_y,
+                jnp.asarray(count, jnp.int32), jnp.asarray(shard, jnp.int32),
+            )
+            new_pool = pool.replace(x=x, oracle_y=y, codes=codes, n_filled=nf)
+        return new_pool, global_fill
+
+    return ingest
+
+
+def grow_sharded_slab(pool: SlabPool, mesh, n_slabs: int = 1) -> SlabPool:
+    """Extend EVERY shard's block by ``n_slabs`` fresh slabs, shard-locally.
+
+    Each shard pads its own contiguous block in place (one shard_map, zero
+    collectives); global capacity grows by ``S * n_slabs * slab_rows`` and
+    the per-shard watermark leaf carries over untouched (local fills are
+    positions within the shard's block, which only grew at the tail). Global
+    row indices RENUMBER (``shard * rows`` strides widen) — the same
+    shape-change boundary at which programs recompile, so no live program
+    ever sees indices across a growth.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from distributed_active_learning_tpu.parallel import mesh as mesh_lib
+    from distributed_active_learning_tpu.utils.compat import shard_map
+
+    data = mesh_lib.AXIS_DATA
+    pad = n_slabs * pool.slab_rows
+
+    def _body(x, y, m, c):
+        return (
+            jnp.pad(x, ((0, pad), (0, 0))),
+            jnp.pad(y, (0, pad)),
+            jnp.pad(m, (0, pad)),
+            jnp.pad(c, ((0, pad), (0, 0))),
+        )
+
+    x, y, m, c = shard_map(
+        _body,
+        mesh=mesh,
+        in_specs=(P(data, None), P(data), P(data), P(data, None)),
+        out_specs=(P(data, None), P(data), P(data), P(data, None)),
+        check_vma=False,
+    )(pool.x, pool.oracle_y, pool.labeled_mask, pool.codes)
+    # Re-place on the canonical P("data") spec (see shard_slab_pool): the
+    # grown pool must present the same cache key to the NEXT capacity's
+    # fresh ingest closure as a freshly sharded pool would, so growth pays
+    # exactly one compile — the per-capacity contract.
+    spec = P(data)
+    return pool.replace(
+        x=mesh_lib.global_put(x, mesh, spec),
+        oracle_y=mesh_lib.global_put(y, mesh, spec),
+        labeled_mask=mesh_lib.global_put(m, mesh, spec),
+        codes=mesh_lib.global_put(c, mesh, spec),
+    )
+
+
+def rebalance_plan(fills: jnp.ndarray, block_rows: int) -> jnp.ndarray:
+    """The epoch's move matrix ``[S, S] int32``: ``plan[i, j]`` rows go i→j.
+
+    Pure and replicated: every shard computes the identical plan from the
+    all-gathered ``[S]`` fill vector. Donors are shards above the floor
+    target ``total // S``, receivers below it; per-shard movement is capped
+    at ``block_rows`` (the epoch's window-sized budget — a badly skewed pool
+    converges over a few epochs rather than paying one pool-scale shuffle).
+    The matching is the interval overlap of donor/receiver cumulative runs,
+    so it is exact, order-stable, and never moves more than the smaller of
+    total excess/deficit.
+    """
+    n_shards = fills.shape[0]
+    fills = jnp.asarray(fills, jnp.int32)
+    target = jnp.sum(fills) // n_shards
+    excess = jnp.clip(fills - target, 0, block_rows)
+    deficit = jnp.clip(target - fills, 0, block_rows)
+    dc = jnp.cumsum(excess)
+    rc = jnp.cumsum(deficit)
+    dlo = dc - excess
+    rlo = rc - deficit
+    overlap = (
+        jnp.minimum(dc[:, None], rc[None, :])
+        - jnp.maximum(dlo[:, None], rlo[None, :])
+    )
+    return jnp.clip(overlap, 0, block_rows).astype(jnp.int32)
+
+
+def rebalance_trigger(fills, ratio: float = 2.0) -> bool:
+    """Host-side epoch trigger: fire when max/min shard fill exceeds
+    ``ratio`` (an empty shard next to a non-empty one always fires). O(S)
+    on the watermark vector only."""
+    f = np.asarray(fills)
+    if f.size <= 1 or f.max() == 0:
+        return False
+    if f.min() == 0:
+        return True
+    return float(f.max()) / float(f.min()) > ratio
+
+
+def make_rebalance_fn(mesh, block_rows: int):
+    """Build the jitted donated rebalance-epoch program.
+
+    ``rebalance(pool) -> (pool, moved_src, moved_dst)`` runs one epoch: all
+    shards agree on a :func:`rebalance_plan` from the all-gathered fills,
+    donors pack their TOPMOST filled rows (content, labels, codes — labeled
+    rows move with their labels, and nothing re-bins) into a per-target
+    ``[S, block_rows]`` buffer, ONE window-sized ``all_to_all``
+    (:func:`parallel.collectives.exchange_blocks`) swaps the buffers, and
+    receivers append the valid rows at their own watermark. Donor rows past
+    the shrunk watermark get their labeled bits cleared — the slab tail
+    contract (tail content is unobservable, tail mask is False) holds on
+    every shard after the epoch.
+
+    ``moved_src``/``moved_dst`` ``[S, S * block_rows] int32`` are the
+    epoch's global-index map (``MOVED_SENTINEL`` pads unused slots): row
+    ``s`` lists the rows shard ``s`` RECEIVED as ``old global idx -> new
+    global idx``. Selection over the rebalanced pool recovers
+    pre-rebalance identities through ``ops/ring_topk.remap_indices`` — the
+    ring-top-k exactness argument needs only this contiguous-block index
+    recovery, which is why the permutation can ride a window-sized map
+    instead of forcing a pool-scale renumbering.
+
+    A balanced pool yields an all-zero plan and the epoch is a pure no-op
+    (identical watermarks, empty map) at unchanged per-launch bytes — safe
+    to run on a timer. Same per-capacity fresh-closure compile contract as
+    the ingest factories.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from distributed_active_learning_tpu.parallel import collectives, mesh as mesh_lib
+    from distributed_active_learning_tpu.utils.compat import shard_map
+
+    data = mesh_lib.AXIS_DATA
+    n_shards = mesh.shape[data]
+
+    def _body(x, y, m, c, nf):
+        rows, d = x.shape
+        me = jax.lax.axis_index(data)
+        fill = nf[0]
+        fills = collectives.gather_fills(fill, data)
+        plan = rebalance_plan(fills, block_rows)
+        send_counts = plan[me]                       # [S] rows I send per target
+        sent = jnp.sum(send_counts)
+        recv_total = jnp.sum(plan[:, me])
+        # Pack: my topmost `sent` filled rows, partitioned per target in
+        # target order. Slot (j, b) holds my row fill - sent + off[j] + b.
+        off = jnp.cumsum(send_counts) - send_counts
+        slot = jnp.arange(block_rows, dtype=jnp.int32)
+        slot_valid = slot[None, :] < send_counts[:, None]      # [S, block]
+        src_local = fill - sent + off[:, None] + slot[None, :]
+        src_safe = jnp.clip(src_local, 0, rows - 1)
+        send_g = jnp.where(
+            slot_valid, (me * rows + src_safe).astype(jnp.int32), MOVED_SENTINEL
+        )
+        exch = lambda t: collectives.exchange_blocks(t, data)
+        rx = exch(x[src_safe])
+        ry = exch(y[src_safe])
+        rm = exch(m[src_safe])
+        rcodes = exch(c[src_safe])
+        rg = exch(send_g)
+        rvalid = exch(slot_valid)
+        # Compact received rows (valid first, stable in sender order) and
+        # append at my watermark. Invalid slots scatter out of bounds and
+        # drop — never a clamped overwrite of real rows. Receivers have room
+        # by construction: fill + recv_total <= target <= rows.
+        flat = n_shards * block_rows
+        rvalid_f = rvalid.reshape(flat)
+        order = jnp.argsort(jnp.logical_not(rvalid_f), stable=True)
+        taken = rvalid_f[order]
+        dst_local = jnp.where(
+            taken, fill + jnp.arange(flat, dtype=jnp.int32), rows
+        )
+        x_out = x.at[dst_local].set(rx.reshape(flat, d)[order], mode="drop")
+        y_out = y.at[dst_local].set(ry.reshape(flat)[order], mode="drop")
+        m_out = m.at[dst_local].set(rm.reshape(flat)[order], mode="drop")
+        c_out = c.at[dst_local].set(
+            rcodes.reshape(flat, c.shape[1])[order], mode="drop"
+        )
+        new_fill = fill - sent + recv_total
+        # Donor tail contract: rows shipped away fall past the shrunk
+        # watermark; their labeled bits must not linger.
+        m_out = m_out & (jnp.arange(rows) < new_fill)
+        moved_src = jnp.where(taken, rg.reshape(flat)[order], MOVED_SENTINEL)
+        moved_dst = jnp.where(taken, me * rows + dst_local, MOVED_SENTINEL)
+        return (
+            x_out, y_out, m_out, c_out,
+            new_fill.astype(nf.dtype)[None],
+            moved_src[None], moved_dst[None],
+        )
+
+    sharded = shard_map(
+        _body,
+        mesh=mesh,
+        in_specs=(P(data, None), P(data), P(data), P(data, None), P(data)),
+        out_specs=(
+            P(data, None), P(data), P(data), P(data, None), P(data),
+            P(data), P(data),
+        ),
+        check_vma=False,
+    )
+
+    # Same 1-wide-axis placement pin as the ingest factory: the donated
+    # pool must round-trip with its P("data") shardings intact or the next
+    # epoch recompiles.
+    out_shardings = (
+        jax.sharding.NamedSharding(mesh, P(data)),
+        jax.sharding.NamedSharding(mesh, P(data)),
+        jax.sharding.NamedSharding(mesh, P(data)),
+    )
+
+    @functools.partial(
+        jax.jit, donate_argnums=(0,), out_shardings=out_shardings
+    )
+    def rebalance(
+        pool: SlabPool,
+    ) -> Tuple[SlabPool, jnp.ndarray, jnp.ndarray]:
+        with jax.named_scope("serve/pod_rebalance"):
+            x, y, m, c, nf, moved_src, moved_dst = sharded(
+                pool.x, pool.oracle_y, pool.labeled_mask, pool.codes,
+                pool.n_filled,
+            )
+            new_pool = pool.replace(
+                x=x, oracle_y=y, labeled_mask=m, codes=c, n_filled=nf
+            )
+        return new_pool, moved_src, moved_dst
+
+    return rebalance
+
+
 def score_body(forest, queries: jnp.ndarray):
     """The resident-forest scoring computation, shared by the single-tenant
     endpoint (:func:`make_score_fn`) and the cross-tenant batched endpoint

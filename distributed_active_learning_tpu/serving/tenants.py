@@ -1153,6 +1153,12 @@ class Tenant:
             self.checkpoint_dir, state, self._forest, self.result,
             fingerprint=ckpt_lib.config_fingerprint(self.cfg),
             tenant=self._ckpt_name,
+            # Live bin-refresh state: a drift-refreshed service re-coded its
+            # slab against these edges, and the resident forest was fitted
+            # on those codes — a restore must re-code from the SAME edges,
+            # not the cold-start ones (_try_restore).
+            edges=self._edges,
+            edges_epoch=self._edges_epoch,
         )
 
     def _try_restore(self, ckpt_dir: str) -> bool:
@@ -1174,7 +1180,25 @@ class Tenant:
         )
         if restored is None:
             return False
-        x, y, mask, n_filled, key_data, rnd, forest, result = restored
+        (
+            x, y, mask, n_filled, key_data, rnd, forest, result,
+            edges, edges_epoch,
+        ) = restored
+        if edges is not None and int(edges_epoch) > self._edges_epoch:
+            # The checkpointed service had drift-refreshed its bin edges:
+            # the restored forest was fitted on codes quantized against
+            # THOSE edges, so adopt them before re-coding the slab below —
+            # re-binning from the cold-start edges would pair the restored
+            # forest with codes it never saw. The cold-start program set
+            # (built above for the restore template) captured the old
+            # edges; drop it so the next use rebuilds at this epoch
+            # (_install_programs rejects stale-epoch sets the same way a
+            # live refresh does).
+            self._edges = jnp.asarray(edges)
+            self._edges_epoch = int(edges_epoch)
+            self._set_edge_bounds()
+            with self._programs_lock:
+                self._programs = {}
         self._slab = slab_lib.init_slab_pool(
             x, y, mask, self._edges, self.serve.slab_rows
         )

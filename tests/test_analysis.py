@@ -422,7 +422,7 @@ def test_mesh_chunk_audits_clean(devices):
 @pytest.mark.slow  # the full matrix (~80+ traced programs, ~60s) runs in CI
 def test_full_registry_audits_clean():
     report = run_audit(build_registry())
-    assert len(report.programs) >= 58
+    assert len(report.programs) >= 60
     assert report.findings == [], [str(f) for f in report.findings]
 
 
@@ -1143,6 +1143,93 @@ def test_auditor_catches_pool_scale_ring(devices):
     # the ring itself is a sanctioned primitive: shipping too much is the
     # budget rule's finding, not the PR-6 collective lint's
     assert "collective-in-shard-map" not in _rules_fired(findings)
+
+
+def test_registry_covers_pod_ingest_kind(devices):
+    """The pod-sharded data path (per-shard watermark append + the
+    rebalancing epoch) audits as its own kind — mesh-only (the cpu spelling
+    is the serve/ingest kind) — with the slab as the donated carry, so the
+    donation/carry rules police the ingest loop exactly as they do serve."""
+    specs = build_registry(kinds=["pod_ingest"])
+    names = {s.name for s in specs}
+    assert names == {
+        "pod_ingest/append/mesh4x2",
+        "pod_ingest/rebalance/mesh4x2",
+    }
+    # a cpu-only placement filter must not smuggle pod programs back in
+    assert build_registry(kinds=["pod_ingest"], placements=["cpu"]) == []
+    unit = next(
+        s for s in specs if s.name == "pod_ingest/append/mesh4x2"
+    ).build()
+    assert unit.pool_rows == 64
+    assert unit.expect_donation
+    assert unit.carry_in_argnums == (0,)
+
+
+def test_pod_ingest_programs_audit_clean(devices):
+    """The sharded append's only collective is the psum'd global-fill
+    scalar; the rebalance epoch ships the [S] fill gather plus WINDOW-sized
+    all_to_all row blocks — sanctioned under the pool-aware shard_map lint
+    and far inside the byte budget. Both must trace to zero findings."""
+    report = run_audit(build_registry(kinds=["pod_ingest"]))
+    assert sorted(report.programs) == [
+        "pod_ingest/append/mesh4x2",
+        "pod_ingest/rebalance/mesh4x2",
+    ]
+    assert report.findings == [], [str(f) for f in report.findings]
+    # the accounted traffic is the contract, not an accident: the append's
+    # psum is one scalar, the rebalance's exchange is window- not
+    # pool-sized (pool x/y/mask/codes alone would be > 1.5 KiB PER leaf)
+    assert report.stats["pod_ingest/append/mesh4x2"]["collective_bytes"] <= 16
+    assert (
+        report.stats["pod_ingest/rebalance/mesh4x2"]["collective_bytes"]
+        < 2048
+    )
+
+
+def test_auditor_catches_pool_scale_all_to_all(devices):
+    """The seeded anti-fixture for the rebalance contract: an epoch that
+    exchanges WHOLE per-shard slabs (every row, not the window-sized
+    movement plan) must trip the byte budget. The per-shard operand is
+    [S, rows] — no single dim reaches pool_rows, so the SHAPE-based lints
+    cannot see it; the byte accounting is the backstop that can't be fooled
+    by re-tiling. Rebalancing by full-pool shuffle is the Spark-era
+    spelling this audit exists to keep out."""
+    from distributed_active_learning_tpu.utils.compat import shard_map
+
+    mesh, P = _mesh_and_P(devices)
+
+    @jax.jit
+    def planted(x):
+        def body(xb):
+            # ships the ENTIRE local slab to every peer: [S, rows] per shard
+            every = jnp.broadcast_to(xb[None], (4,) + xb.shape)
+            swapped = jax.lax.all_to_all(
+                every, "data", split_axis=0, concat_axis=0, tiled=True
+            )
+            return swapped.sum(axis=0)
+
+        return shard_map(
+            body, mesh=mesh, in_specs=P("data"), out_specs=P("data"),
+            check_vma=False,
+        )(x)
+
+    # 64-row pool over 4 data shards: the planted exchange moves 4 x 16
+    # rows x 4 B = 256 B per shard per launch — pool-scale, vs the real
+    # epoch's block_rows-bounded plan. Budget pinned at real-epoch traffic.
+    unit = AuditUnit(
+        name="fixture/pool-all-to-all", fn=planted,
+        args=(_sds((64,), jnp.float32),),
+        pool_rows=64, collective_bytes_budget=120.0,
+    )
+    stats = {}
+    fired = _rules_fired(audit_unit(unit, stats=stats))
+    assert stats["collective_bytes"] == 256.0
+    assert "collective-bytes-over-budget" in fired
+    # the [S, rows] tiling keeps every dim under pool_rows, so the
+    # shape-based lints stay quiet — the bytes rule is the one that holds
+    assert "pool-scale-collective" not in fired
+    assert "collective-in-shard-map" not in fired
 
 
 def test_specs_for_experiment_fused_round_routes_to_fused_chunk():

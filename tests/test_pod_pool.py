@@ -1,0 +1,540 @@
+"""Pod-sharded data path (serving/slab.py): shard-local ingest, rebalancing
+epochs, per-shard reveal, and the scenario engine's shard-local draws.
+
+The contract under test is EXACTNESS, not approximation: interleaved
+per-shard appends plus a rebalance epoch must preserve every ingested row
+bit-for-bit (content, label, mask, codes), the fused selection over the
+ingest-built sharded pool must match the single-device megakernel over the
+same contents (scores, indices, tie-breaks), and the per-shard reveal /
+flip draws must concatenate to their single-device spellings exactly. The
+mesh is 8 virtual CPU devices (conftest); the heavier strategy/epoch
+matrix rides the slow mark, tier 1 pins one configuration of each claim.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distributed_active_learning_tpu.ops import trees_train
+from distributed_active_learning_tpu.parallel import make_mesh
+from distributed_active_learning_tpu.serving import slab
+from distributed_active_learning_tpu.runtime import state as state_lib
+from distributed_active_learning_tpu.runtime import telemetry
+
+D = 4
+BINS = 8
+SLAB_ROWS = 64   # single-slab granularity: one slab holds the whole start set
+ROWS = 16        # per-shard rows at the initial capacity (64 / 4 data shards)
+
+
+def _points(rng, n):
+    """Continuous random content: distinct rows, so content identity is
+    checkable bit-for-bit without manufactured collisions."""
+    x = rng.normal(size=(n, D)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.int32)
+    return x, y
+
+
+def _start_pool(rng, n0=32, labeled=12):
+    x0, y0 = _points(rng, n0)
+    mask0 = np.zeros(n0, bool)
+    mask0[rng.permutation(n0)[:labeled]] = True
+    edges = trees_train.make_bins(jnp.asarray(x0), BINS).edges
+    pool = slab.init_slab_pool(x0, y0, mask0, edges, SLAB_ROWS)
+    return pool, edges, x0, y0, mask0
+
+
+def _readback(pool):
+    """Host copies of every slab leaf (works on sharded and dense pools)."""
+    return (
+        np.asarray(jax.device_get(pool.x)),
+        np.asarray(jax.device_get(pool.oracle_y)),
+        np.asarray(jax.device_get(pool.labeled_mask)),
+        np.asarray(jax.device_get(pool.codes)),
+        np.asarray(jax.device_get(pool.n_filled)),
+    )
+
+
+# ---------------------------------------------------------------------------
+# placement + plan algebra (cheap, no jit of the big programs)
+# ---------------------------------------------------------------------------
+
+
+def test_shard_slab_pool_watermark_split_and_refusals(devices):
+    rng = np.random.default_rng(0)
+    pool, *_ = _start_pool(rng)
+    mesh = make_mesh(data=4, model=2)
+    sharded = slab.shard_slab_pool(pool, mesh)
+    # 32 contiguous rows over 16-row shards: [16, 16, 0, 0]
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(sharded.n_filled)), [16, 16, 0, 0]
+    )
+    # capacity must divide the data axis
+    odd = pool.replace(
+        x=jnp.pad(pool.x, ((0, 2), (0, 0))),
+        oracle_y=jnp.pad(pool.oracle_y, (0, 2)),
+        labeled_mask=jnp.pad(pool.labeled_mask, (0, 2)),
+        codes=jnp.pad(pool.codes, ((0, 2), (0, 0))),
+    )
+    with pytest.raises(ValueError, match="not divisible"):
+        slab.shard_slab_pool(odd, mesh)
+    # a per-shard leaf of the wrong width is a config error, not a reshape
+    with pytest.raises(ValueError, match="does not match the data axis"):
+        slab.shard_slab_pool(
+            pool.replace(n_filled=jnp.zeros(3, jnp.int32)), mesh
+        )
+
+
+def test_route_to_shard_picks_least_filled():
+    assert slab.route_to_shard([16, 16, 0, 0]) == 2
+    assert slab.route_to_shard([3, 1, 1, 9]) == 1  # tie -> lowest index
+    assert slab.route_to_shard([0]) == 0
+
+
+def test_rebalance_plan_interval_matching():
+    plan = np.asarray(slab.rebalance_plan(jnp.array([16, 16, 8, 0]), 4))
+    # target 10; donors 0,1 capped at 4; receivers 2 (deficit 2) and 3 (4)
+    np.testing.assert_array_equal(
+        plan,
+        [[0, 0, 2, 2], [0, 0, 0, 2], [0, 0, 0, 0], [0, 0, 0, 0]],
+    )
+    # balanced pool: the all-zero plan (the no-op epoch)
+    assert not np.asarray(slab.rebalance_plan(jnp.array([8, 8, 8, 8]), 4)).any()
+    # no shard both donates and receives, movement capped by the window
+    fills = jnp.array([31, 2, 19, 0])
+    p = np.asarray(slab.rebalance_plan(fills, 4))
+    assert p.max() <= 4
+    donors = p.sum(axis=1) > 0
+    receivers = p.sum(axis=0) > 0
+    assert not np.any(donors & receivers)
+
+
+def test_rebalance_trigger_fill_imbalance():
+    assert not slab.rebalance_trigger([0, 0, 0, 0])   # empty pool: nothing to move
+    assert not slab.rebalance_trigger([5])            # one shard: no peers
+    assert slab.rebalance_trigger([8, 8, 8, 0])       # an empty shard always fires
+    assert not slab.rebalance_trigger([8, 8, 8, 4])   # ratio 2.0 is the edge
+    assert slab.rebalance_trigger([9, 8, 8, 4])       # just past it
+    assert slab.rebalance_trigger([8, 8, 8, 5], ratio=1.5)
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 parity pin: interleaved appends + one rebalance epoch, then the
+# fused round, bit-identical to the single-device spelling over the same pool
+# ---------------------------------------------------------------------------
+
+
+def _ingest_blocks(pool, ingest, edges, blocks, arrival_of, base_arrival):
+    """Drive the sharded ingest like the service would: route each block to
+    the least-filled shard, append, and record which arrival landed in which
+    global row (``arrival_of[global_idx] = arrival id``)."""
+    n_shards = np.asarray(jax.device_get(pool.n_filled)).shape[0]
+    rows = pool.capacity // n_shards
+    arrival = base_arrival
+    for bx, by, count in blocks:
+        fills = np.asarray(jax.device_get(pool.n_filled))
+        shard = slab.route_to_shard(fills)
+        pool, global_fill = ingest(
+            pool, edges, jnp.asarray(bx), jnp.asarray(by), count, shard
+        )
+        start = shard * rows + int(fills[shard])
+        arrival_of[start:start + count] = np.arange(arrival, arrival + count)
+        arrival += count
+    return pool, arrival
+
+
+def _check_contents(pool, arrival_of, all_x, all_y, all_mask, edges):
+    """Every filled global row holds exactly the arrival the host map says
+    it does — features, label, mask bit, and codes, all bit-for-bit — and
+    the tail past each shard's watermark is mask-False."""
+    x, y, m, codes, fills = _readback(pool)
+    rows = pool.capacity // fills.shape[0]
+    want_codes = np.asarray(trees_train.code_features(jnp.asarray(all_x), edges))
+    for s, fill in enumerate(fills):
+        for local in range(rows):
+            g = s * rows + local
+            if local >= fill:
+                assert not m[g], f"tail mask set at shard {s} row {local}"
+                assert arrival_of[g] < 0
+                continue
+            a = arrival_of[g]
+            assert a >= 0, f"untracked filled row {g}"
+            np.testing.assert_array_equal(x[g], all_x[a])
+            assert y[g] == all_y[a]
+            assert m[g] == all_mask[a]
+            np.testing.assert_array_equal(codes[g], want_codes[a])
+
+
+def _apply_move_map(arrival_of, moved_src, moved_dst):
+    src = np.asarray(jax.device_get(moved_src)).reshape(-1)
+    dst = np.asarray(jax.device_get(moved_dst)).reshape(-1)
+    valid = src >= 0
+    assert np.array_equal(valid, dst >= 0)
+    moved = arrival_of[src[valid]]
+    assert np.all(moved >= 0), "rebalance shipped an unfilled row"
+    arrival_of[src[valid]] = -1
+    arrival_of[dst[valid]] = moved
+    return int(valid.sum())
+
+
+def _fit_forest_on(x, y, mask):
+    """The product fit path over given pool contents (mirrors the
+    test_round_fused fixture, but on OUR ingested rows)."""
+    binned = trees_train.make_bins(jnp.asarray(x), BINS)
+    c, yy, w = trees_train.gather_fit_window(
+        binned.codes, jnp.asarray(y), jnp.asarray(mask), 128
+    )
+    f, th, v = trees_train.fit_forest_device(
+        c, yy, w, binned.edges, jax.random.key(0),
+        n_trees=8, max_depth=3, n_bins=BINS,
+    )
+    return trees_train.heap_gemm_forest(f, th, v, 3)
+
+
+def _selection_parity(mesh, pool, arrival_of, all_x, all_y, all_mask, strategies, k=7):
+    """Fused selection over the sharded pool vs the single-device megakernel
+    over a dense pool of the SAME contents in the SAME global row order —
+    scores, indices, and tie-breaks must agree bitwise (the vote scores are
+    discrete, so ties are the common case, not the corner)."""
+    from distributed_active_learning_tpu.ops import round_fused
+    from distributed_active_learning_tpu.ops.trees_pallas import (
+        PallasForest,
+        ShardedPallasForest,
+    )
+
+    x, y, m, codes, fills = _readback(pool)
+    rows = pool.capacity // fills.shape[0]
+    valid = np.zeros(pool.capacity, bool)
+    for s, fill in enumerate(fills):
+        valid[s * rows:s * rows + fill] = True
+    sel = jnp.asarray(valid & ~m)
+    gf = _fit_forest_on(all_x, all_y, all_mask)
+    sharded_f = ShardedPallasForest(gf=gf, mesh=mesh)
+    for name in strategies:
+        v_pod, i_pod = round_fused.fused_score_select(
+            sharded_f, pool.x, sel, name, k
+        )
+        v_ref, i_ref = round_fused.fused_score_select(
+            PallasForest(gf=gf), jnp.asarray(x), sel, name, k
+        )
+        np.testing.assert_array_equal(np.asarray(v_pod), np.asarray(v_ref))
+        np.testing.assert_array_equal(np.asarray(i_pod), np.asarray(i_ref))
+        # every pick is a live unlabeled row the host map can name
+        for g in np.asarray(i_pod):
+            assert valid[g] and not m[g] and arrival_of[g] >= 0
+
+
+def _run_data_path(mesh, *, epochs=1, grow=False, strategies=("entropy",)):
+    rng = np.random.default_rng(11)
+    pool, edges, x0, y0, mask0 = _start_pool(rng)
+    n_extra = 40
+    xa, ya = _points(rng, n_extra)
+    all_x = np.concatenate([x0, xa])
+    all_y = np.concatenate([y0, ya])
+    all_mask = np.concatenate([mask0, np.zeros(n_extra, bool)])
+
+    sharded = slab.shard_slab_pool(pool, mesh)
+    arrival_of = np.full(sharded.capacity, -1, np.int64)
+    arrival_of[:16] = np.arange(16)        # shard 0: rows 0..15 of the start set
+    arrival_of[ROWS:ROWS + 16] = np.arange(16, 32)   # shard 1: rows 16..31
+
+    ingest = slab.make_sharded_ingest_fn(mesh)
+    blocks = [
+        (xa[0:8], ya[0:8], 8),
+        (xa[8:16], ya[8:16], 8),
+        # a partial block: pad rows ride along past the advanced watermark
+        (np.concatenate([xa[16:21], np.zeros((3, D), np.float32)]),
+         np.concatenate([ya[16:21], np.zeros(3, np.int32)]), 5),
+    ]
+    sharded, arrival = _ingest_blocks(
+        sharded, ingest, edges, blocks, arrival_of, 32
+    )
+    assert telemetry.jit_cache_size(ingest) == 1  # one executable, 3 appends
+    _check_contents(sharded, arrival_of, all_x, all_y, all_mask, edges)
+
+    rebalance = slab.make_rebalance_fn(mesh, block_rows=8)
+    fills = np.asarray(jax.device_get(sharded.n_filled))
+    # [16, 16, 13, 8]: skewed at exactly 2.0 — the sharper service knob fires
+    np.testing.assert_array_equal(fills, [16, 16, 13, 8])
+    assert slab.rebalance_trigger(fills, ratio=1.5)
+    for _ in range(epochs):
+        sharded, moved_src, moved_dst = rebalance(sharded)
+        _apply_move_map(arrival_of, moved_src, moved_dst)
+    assert telemetry.jit_cache_size(rebalance) == 1
+    new_fills = np.asarray(jax.device_get(sharded.n_filled))
+    assert new_fills.sum() == fills.sum()            # nothing lost or invented
+    assert new_fills.max() - new_fills.min() < fills.max() - fills.min()
+    _check_contents(sharded, arrival_of, all_x, all_y, all_mask, edges)
+    _selection_parity(
+        mesh, sharded, arrival_of, all_x, all_y, all_mask, strategies
+    )
+
+    if grow:
+        grown = slab.grow_sharded_slab(sharded, mesh)
+        # growth is per shard: every shard gains a fresh slab_rows block
+        assert grown.capacity == sharded.capacity + 4 * sharded.slab_rows
+        # growth renumbers global rows: re-anchor the host map per shard
+        old_rows, new_rows = ROWS, grown.capacity // 4
+        re_anchored = np.full(grown.capacity, -1, np.int64)
+        for s in range(4):
+            re_anchored[s * new_rows:s * new_rows + old_rows] = (
+                arrival_of[s * old_rows:(s + 1) * old_rows]
+            )
+        # a fresh per-capacity closure: appends at the new shape stay flat
+        ingest2 = slab.make_sharded_ingest_fn(mesh)
+        blocks2 = [(xa[21:29], ya[21:29], 8), (xa[29:37], ya[29:37], 8)]
+        grown, arrival = _ingest_blocks(
+            grown, ingest2, edges, blocks2, re_anchored, arrival
+        )
+        assert telemetry.jit_cache_size(ingest2) == 1
+        assert telemetry.jit_cache_size(ingest) == 1  # old closure untouched
+        _check_contents(grown, re_anchored, all_x, all_y, all_mask, edges)
+        _selection_parity(
+            mesh, grown, re_anchored, all_x, all_y, all_mask, strategies
+        )
+
+
+def test_sharded_ingest_rebalance_fused_round_parity(devices):
+    # one strategy, one epoch, no growth in tier 1 (each variant is another
+    # shard compile); the slow twin sweeps strategies, growth, and a second
+    # epoch on the same mesh
+    _run_data_path(make_mesh(data=4, model=2))
+
+
+@pytest.mark.slow
+def test_sharded_data_path_parity_matrix(devices):
+    _run_data_path(
+        make_mesh(data=4, model=2),
+        epochs=2,
+        grow=True,
+        strategies=("uncertainty", "margin", "entropy"),
+    )
+
+
+def test_rebalanced_selection_recovers_indices(devices):
+    """ops/ring_topk.remap_indices maps post-rebalance picks back to their
+    pre-rebalance global identities — the contiguous-block index recovery
+    the ring-top-k exactness argument leans on."""
+    from distributed_active_learning_tpu.ops import ring_topk as rt
+
+    moved_src = jnp.array([[4, 61, -1], [-1, -1, -1]])
+    moved_dst = jnp.array([[33, 17, -1], [-1, -1, -1]])
+    idx = jnp.array([33, 5, 17, 2])
+    np.testing.assert_array_equal(
+        np.asarray(rt.remap_indices(idx, moved_src, moved_dst)),
+        [4, 5, 61, 2],
+    )
+    # MOVED_SENTINEL slots never capture a real index (index -1 impossible)
+    np.testing.assert_array_equal(
+        np.asarray(rt.remap_indices(jnp.array([0, 1]), moved_src, moved_dst)),
+        [0, 1],
+    )
+
+
+# ---------------------------------------------------------------------------
+# per-shard reveal + the scenario engine's shard-local draws
+# ---------------------------------------------------------------------------
+
+
+def _local_reveal_concat(mesh, mask, picked, keep, **kw):
+    from jax.sharding import PartitionSpec as P
+    from distributed_active_learning_tpu.utils.compat import shard_map
+
+    rows = mask.shape[0] // mesh.shape["data"]
+
+    def body(m_blk):
+        me = jax.lax.axis_index("data")
+        return state_lib.reveal_masked_local(m_blk, picked, keep, me, rows, **kw)
+
+    return shard_map(
+        body, mesh=mesh, in_specs=P("data"), out_specs=P("data"),
+        check_vma=False,
+    )(mask)
+
+
+def test_reveal_masked_local_concat_parity(devices):
+    mesh = make_mesh(data=4, model=2)
+    rng = np.random.default_rng(3)
+    mask = jnp.asarray(rng.random(64) < 0.3)
+    picked = jnp.asarray(rng.permutation(64)[:7].astype(np.int32))
+    keep = jnp.asarray(rng.random(7) < 0.7)
+    st = state_lib.PoolState(
+        x=jnp.zeros((64, D)), oracle_y=jnp.zeros(64, jnp.int32),
+        labeled_mask=mask, key=jax.random.key(0),
+        round=jnp.asarray(0, jnp.int32), n_filled=jnp.asarray(64, jnp.int32),
+    )
+    want = state_lib.reveal_masked(st, picked, keep).labeled_mask
+    got = _local_reveal_concat(mesh, mask, picked, keep)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    # the abstaining (noisy-oracle) reveal: every shard draws the same
+    # window from the replicated key, so parity holds probabilistically too
+    akey = jax.random.key(5)
+    want_a = state_lib.reveal_masked(
+        st, picked, keep, abstain_key=akey, abstain_prob=0.4
+    ).labeled_mask
+    got_a = _local_reveal_concat(
+        mesh, mask, picked, keep, abstain_key=akey, abstain_prob=0.4
+    )
+    np.testing.assert_array_equal(np.asarray(got_a), np.asarray(want_a))
+    # the guard that the abstain path was actually exercised: the draw is a
+    # pure function of the replicated key, so compute it on the host and
+    # check the masks diverge exactly where an abstained pick was fresh
+    draw = np.asarray(jax.random.uniform(akey, picked.shape)) >= 0.4
+    expect = np.asarray(mask).copy()
+    expect[np.asarray(picked)[np.asarray(keep) & draw]] = True
+    np.testing.assert_array_equal(np.asarray(want_a), expect)
+
+
+def test_scenario_block_draws_concat_parity():
+    from distributed_active_learning_tpu.config import ScenarioConfig
+    from distributed_active_learning_tpu.scenarios import engine
+
+    scn = ScenarioConfig(kind="noisy_oracle", flip_prob=0.3, abstain_prob=0.5)
+    full = np.asarray(engine.flip_mask(scn, 9, 64))
+    got = np.concatenate([
+        np.asarray(engine.flip_mask_block(scn, 9, 64, s, 16)) for s in range(4)
+    ])
+    np.testing.assert_array_equal(got, full)
+    # the abstain draw is scenario-gated: noisy oracles draw, others answer
+    key = jax.random.key(2)
+    draw = np.asarray(engine.abstain_draw(scn, key, (5,)))
+    want = np.asarray(jax.random.uniform(key, (5,)) >= 0.5)
+    np.testing.assert_array_equal(draw, want)
+    clean = ScenarioConfig(kind="cost_budget")
+    assert np.asarray(engine.abstain_draw(clean, key, (5,))).all()
+
+
+def test_noisy_oracle_rides_the_mesh_other_scenarios_refused():
+    """The mesh refusal is now scenario-SELECTIVE: noisy_oracle passes
+    validation (flips land before sharding, the abstain draw is
+    window-sized), every other kind still names the single-device limit."""
+    from distributed_active_learning_tpu.config import (
+        DataConfig,
+        ExperimentConfig,
+        ForestConfig,
+        MeshConfig,
+        ScenarioConfig,
+        StrategyConfig,
+    )
+    from distributed_active_learning_tpu.runtime.loop import run_experiment
+
+    def cfg(kind, **kw):
+        return ExperimentConfig(
+            data=DataConfig(name="checkerboard2x2", n_samples=120, seed=2),
+            forest=ForestConfig(n_trees=8, max_depth=3, fit="device"),
+            # entropy is knapsack-compatible, so the cost_budget case hits
+            # the MESH refusal, not the score-direction one
+            strategy=StrategyConfig(name="entropy", window_size=8),
+            mesh=MeshConfig(data=4, model=2),
+            scenario=ScenarioConfig(kind=kind, **kw),
+            n_start=10,
+            max_rounds=2,
+            seed=7,
+        )
+
+    with pytest.raises(ValueError, match="only noisy_oracle rides"):
+        run_experiment(cfg("drift", drift_rate=0.1))
+    with pytest.raises(ValueError, match="only noisy_oracle rides"):
+        run_experiment(cfg("cost_budget", cost_budget=20.0))
+
+
+@pytest.mark.slow
+def test_noisy_oracle_mesh_matches_single_device(devices):
+    """The acceptance claim behind lifting the refusal: a noisy-oracle cell
+    on the 4x2 mesh reproduces the single-device curve exactly — flips are
+    applied before placement and the abstaining reveal is a window-sized
+    function of the replicated round key, so GSPMD partitioning cannot
+    change a single reveal."""
+    from distributed_active_learning_tpu.config import (
+        DataConfig,
+        ExperimentConfig,
+        ForestConfig,
+        MeshConfig,
+        ScenarioConfig,
+        StrategyConfig,
+    )
+    from distributed_active_learning_tpu.runtime.loop import run_experiment
+
+    def cfg(mesh):
+        return ExperimentConfig(
+            data=DataConfig(name="checkerboard2x2", n_samples=250, seed=2),
+            forest=ForestConfig(n_trees=8, max_depth=4, fit="device"),
+            strategy=StrategyConfig(name="uncertainty", window_size=10),
+            mesh=mesh,
+            scenario=ScenarioConfig(
+                kind="noisy_oracle", flip_prob=0.2, abstain_prob=0.3
+            ),
+            n_start=10,
+            max_rounds=3,
+            seed=7,
+        )
+
+    single = run_experiment(cfg(MeshConfig()))
+    sharded = run_experiment(cfg(MeshConfig(data=4, model=2)))
+    assert [r.n_labeled for r in sharded.records] == [
+        r.n_labeled for r in single.records
+    ]
+    np.testing.assert_allclose(
+        [r.accuracy for r in sharded.records],
+        [r.accuracy for r in single.records],
+        atol=1e-6,
+    )
+
+
+# ---------------------------------------------------------------------------
+# serve checkpoints carry the live bin-refresh state (satellite: a restored
+# drifting service re-codes from its refreshed edges, not cold-start edges)
+# ---------------------------------------------------------------------------
+
+
+def test_serve_checkpoint_round_trips_bin_refresh_state(tmp_path):
+    from distributed_active_learning_tpu.runtime import checkpoint as ckpt
+    from distributed_active_learning_tpu.runtime.results import (
+        ExperimentResult,
+        RoundRecord,
+    )
+
+    rng = np.random.default_rng(4)
+    x = jnp.asarray(rng.normal(size=(20, D)).astype(np.float32))
+    st = state_lib.PoolState(
+        x=x,
+        oracle_y=jnp.asarray(rng.integers(0, 2, 20), jnp.int32),
+        labeled_mask=jnp.asarray(rng.random(20) < 0.5),
+        key=jax.random.key(1),
+        round=jnp.asarray(3, jnp.int32),
+        n_filled=jnp.asarray(17, jnp.int32),
+    )
+    forest = {"w": jnp.arange(6.0)}
+    result = ExperimentResult(
+        records=[
+            RoundRecord(round=0, n_labeled=5, n_unlabeled=15, accuracy=0.5)
+        ]
+    )
+    edges = np.asarray(
+        trees_train.make_bins(x, BINS).edges, np.float32
+    )
+    path = ckpt.save_serve(
+        str(tmp_path), st, forest, result, fingerprint="fp",
+        edges=edges, edges_epoch=2,
+    )
+    assert path is not None
+    restored = ckpt.restore_latest_serve(str(tmp_path), forest, fingerprint="fp")
+    assert restored is not None
+    *_, r_edges, r_epoch = restored
+    assert r_epoch == 2
+    np.testing.assert_array_equal(np.asarray(r_edges), edges)
+    # edges_epoch without the edges leaf is an inconsistent save, refused
+    with pytest.raises(ValueError, match="edges"):
+        ckpt.save_serve(
+            str(tmp_path), st, forest, result, edges=None, edges_epoch=3
+        )
+    # a pre-refresh checkpoint (no leaves) restores to the cold-start
+    # sentinel (None, 0) rather than failing
+    old_dir = tmp_path / "old"
+    old_dir.mkdir()
+    ckpt.save_serve(str(old_dir), st, forest, result, fingerprint="fp")
+    *_, o_edges, o_epoch = ckpt.restore_latest_serve(
+        str(old_dir), forest, fingerprint="fp"
+    )
+    assert o_edges is None and o_epoch == 0

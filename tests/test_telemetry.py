@@ -323,8 +323,10 @@ def test_summarize_metrics_tables(tmp_path, capsys):
 
 def test_summarize_metrics_pod_selection_table(tmp_path, capsys):
     """The "== pod selection ==" table renders one row per well-formed
-    pod_select event (sorted by shard count) and skips malformed events —
-    missing fields, non-numeric strings, bool-typed numbers — never crashing."""
+    pod_select / pod_ingest / rebalance event (sorted by shard count, then
+    select -> ingest -> rebalance) with the shard-balance column, and skips
+    malformed events — missing fields, non-numeric strings, bool-typed
+    numbers — never crashing."""
     import sys
 
     sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
@@ -342,10 +344,21 @@ def test_summarize_metrics_pod_selection_table(tmp_path, capsys):
         {"kind": "pod_select", "shards": 1, "per_shard_rows": 512,
          "per_shard_candidates": 100, "ring_hops": 0,
          "select_seconds": 0.0125, "points_per_second": 40960.0},
-        # malformed: missing shards / non-numeric wall / bool-typed shards
+        # the ingest sub-leg and a rebalance epoch, with the fill extremes
+        # the balance column renders (96/32 = 3.00; post-epoch 72/56 = 1.29)
+        {"kind": "pod_ingest", "shards": 4, "per_shard_rows": 512,
+         "block_rows": 8, "ingest_seconds": 0.004,
+         "points_per_second": 2000.0, "fill_max": 96, "fill_min": 32},
+        {"kind": "rebalance", "shards": 4, "per_shard_rows": 512,
+         "block_rows": 8, "rebalance_seconds": 0.002,
+         "fill_max": 72, "fill_min": 56},
+        # malformed: missing shards / non-numeric wall / bool-typed shards /
+        # an ingest event torn mid-write
         {"kind": "pod_select", "select_seconds": 0.5},
         {"kind": "pod_select", "shards": 2, "select_seconds": "torn"},
         {"kind": "pod_select", "shards": True, "select_seconds": 0.5},
+        {"kind": "pod_ingest", "shards": 4, "ingest_seconds": None},
+        {"kind": "rebalance", "rebalance_seconds": 0.1},
     ]
     with open(path, "w") as fh:
         for e in events:
@@ -354,20 +367,27 @@ def test_summarize_metrics_pod_selection_table(tmp_path, capsys):
     assert summarize_metrics.main([path]) == 0
     out = capsys.readouterr().out
     assert "== pod selection ==" in out
-    assert "ring hops" in out
+    assert "ring hops" in out and "balance" in out
     pod_rows = [
         l for l in out.splitlines()
-        if l.strip() and l.split()[0] in ("1", "4", "2", "True")
+        if l.strip()
+        and l.split()[0] in ("pod_select", "pod_ingest", "rebalance")
     ]
-    assert len(pod_rows) == 2  # the two well-formed events, nothing else
-    assert pod_rows[0].split()[0] == "1"  # sorted by shard count
-    assert pod_rows[1].split()[0] == "4"
+    assert len(pod_rows) == 4  # the four well-formed events, nothing else
+    # sorted by shard count, then select -> ingest -> rebalance within one
+    assert [r.split()[0] for r in pod_rows] == [
+        "pod_select", "pod_select", "pod_ingest", "rebalance"
+    ]
+    assert pod_rows[0].split()[1] == "1"
+    assert pod_rows[1].split()[1] == "4"
     assert "81,920" in out and "0.0250" in out and "torn" not in out
+    assert "3.00" in out and "1.29" in out  # the balance column's ratios
 
     # an all-malformed stream renders no pod table at all
     path2 = str(tmp_path / "pod2.jsonl")
     with open(path2, "w") as fh:
         fh.write(json.dumps({"kind": "pod_select", "shards": "x"}) + "\n")
+        fh.write(json.dumps({"kind": "pod_ingest", "shards": 2}) + "\n")
     assert summarize_metrics.main([path2]) == 0
     assert "== pod selection ==" not in capsys.readouterr().out
 
