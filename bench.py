@@ -1948,6 +1948,251 @@ def bench_serve_multi(args):
     }
 
 
+def bench_serve_fleet(args):
+    """Shared-nothing fleet scaling benchmark (the PR-20 tentpole): N worker
+    processes — each a full TenantManager + frontend + ops plane — behind
+    the consistent-hash router (serving/fleet.py), driven over the binary
+    keep-alive score path by a closed-loop concurrency-1 client.
+
+    Two legs: 1 worker, then ``--fleet-workers`` workers (default 4; CI runs
+    2), SAME tenant set, so ``fleet_qps_scaling_ratio`` is the serve analog
+    of the pod benches' weak-scaling story. What scales with worker count
+    is per-request SERVICE TIME: the grouped score program's tenant axis
+    spans the worker's whole resident group, so 1 worker pays a G=T
+    stacked launch per request while N workers pay G=T/N — and qps at
+    fixed concurrency is the reciprocal reading of that. (On a multi-core
+    host the process axis compounds on top; the smoke measurement does not
+    depend on it.) Tenant ids are chosen so the SHA-1 ring splits them
+    evenly on the smoke worker counts (u0..u7 -> 4/4 at 2 workers,
+    2/2/2/2 at 4): every worker hosts >= 2 same-signature tenants, so the
+    signature-grouped fast path must cover EVERY tenant —
+    ``serve_fleet_shared_sig_fallbacks`` is a hard 0, and so is each
+    worker's ``recompiles_after_warmup`` (the bench scrapes the counter off
+    each worker's OWN ``/metrics`` over HTTP, not just the in-process
+    tally). Traffic is score-only by spec construction (no drift, no
+    growth): a worker's jit cache is sealed at warmup.
+
+    NOT part of ``--mode all``: spawning 2x N JAX processes costs tens of
+    seconds of pure interpreter/compile startup, which would eat the
+    deadline budget of every other mode.
+
+    ``--ops-port`` pins the ROUTER port for the max-workers leg (the CI
+    job's external scrape path; ``/workers`` maps to each worker's own
+    ephemeral ops port); ``--fleet-linger`` holds that leg's fleet up after
+    its traffic completes so an external scraper has a window.
+    """
+    import re as re_lib
+    import urllib.request
+
+    from distributed_active_learning_tpu.runtime import telemetry
+    from distributed_active_learning_tpu.serving.fleet import Fleet, TenantSpec
+
+    d = args.features
+    T = 8
+    tids = [f"u{i}" for i in range(T)]
+    max_workers = max(int(getattr(args, "fleet_workers", None) or 4), 1)
+    worker_counts = sorted({1, max_workers})
+    per_tenant_queries = max(args.serve_queries // T, 40)
+    total_queries = T * per_tenant_queries
+    # The grouped fast path needs a vmappable eval form (pallas would fall
+    # back per-tenant — same constraint as serve-multi).
+    kernel = args.kernel if args.kernel in ("gemm", "gather") else "gemm"
+    pool_rows = min(args.serve_pool, 256)
+    # Forest sized so a stacked launch costs real device time (a toy
+    # forest would bury launches under per-request plumbing, identical at
+    # every worker count): the group axis spans every member — absent
+    # tenants ride as zero-valid padding — so a lone worker hosting all T
+    # tenants pays a G=T launch per request while each of N workers pays
+    # G=T/N. That per-request service-time shrinkage IS what sharding buys
+    # on the launch axis, and it is what the scaling leg measures.
+    score_width = 128
+    n_trees = 24
+    specs = [
+        TenantSpec(
+            tenant_id=tid, features=d, pool_rows=pool_rows, shift=0.4 * i,
+            seed=10 + i, n_trees=n_trees, max_depth=6,
+            kernel=kernel, slab_rows=pool_rows, score_width=score_width,
+        )
+        for i, tid in enumerate(tids)
+    ]
+
+    legs = {}
+    for n in worker_counts:
+        router_port = (
+            (getattr(args, "ops_port", None) or 0) if n == max_workers else 0
+        )
+        fleet = Fleet(specs, n_workers=n, router_port=router_port)
+        t0 = time.perf_counter()
+        fleet.start()
+        warmup_sec = time.perf_counter() - t0
+        _flight(
+            "serve_fleet_leg_start", workers=n,
+            router_port=fleet.router_port,
+        )
+
+        # Closed-loop, concurrency 1, round-robin across tenants: every
+        # request's latency is pure service time (no queueing, no
+        # cross-request coalescing masking the group-size asymmetry), so
+        # qps = 1/latency is a faithful reading of what each topology
+        # charges per request. Deeper client concurrency only re-converges
+        # the legs: the grouped path coalesces a backlog into full-group
+        # launches, which amortizes the big group exactly when loaded.
+        latencies = {tid: [] for tid in tids}
+        rngs = {
+            tid: np.random.default_rng(500 + i)
+            for i, tid in enumerate(tids)
+        }
+        queries_by_tid = {
+            tid: [
+                (rngs[tid].normal(size=(score_width, d)) + 0.4 * i).astype(
+                    np.float32
+                )
+                for _ in range(per_tenant_queries)
+            ]
+            for i, tid in enumerate(tids)
+        }
+        t0 = time.perf_counter()
+        for k in range(per_tenant_queries):
+            for tid in tids:
+                t1 = time.perf_counter()
+                fleet.score(tid, queries_by_tid[tid][k])
+                latencies[tid].append(time.perf_counter() - t1)
+        wall = time.perf_counter() - t0
+
+        # The per-worker hard-zero gate reads each worker's OWN /metrics
+        # over HTTP — the same surface an external scraper sees — not the
+        # in-process tally (which also rides the payload, as a cross-check).
+        worker_recompile_metric = {}
+        for wid in fleet.worker_ids:
+            m = re_lib.search(
+                r"^dal_recompiles_after_warmup_total (\d+)$",
+                fleet.worker_metrics(wid), re_lib.M,
+            )
+            worker_recompile_metric[wid] = int(m.group(1)) if m else None
+        base = f"http://127.0.0.1:{fleet.router_port}"
+        with urllib.request.urlopen(base + "/metrics", timeout=10) as r:
+            agg = r.read().decode()
+        with urllib.request.urlopen(base + "/healthz", timeout=10) as r:
+            health_ok = r.status == 200
+        agg_ok = all(f'worker="{wid}"' in agg for wid in fleet.worker_ids)
+        linger = float(getattr(args, "fleet_linger", 0) or 0)
+        if n == max_workers and linger > 0:
+            # external-scrape window (the CI job curls the pinned router
+            # port mid-run); counters are cumulative, nothing drifts
+            time.sleep(linger)
+        final = fleet.stop()
+        served = sum(
+            f.get("queries", 0) for f in final["workers"].values()
+        )
+        legs[n] = {
+            "qps": round(total_queries / wall, 2),
+            "wall": wall,
+            "warmup": round(warmup_sec, 3),
+            "final": final,
+            "served": served,
+            "worker_recompile_metric": worker_recompile_metric,
+            "agg_ok": agg_ok,
+            "health_ok": health_ok,
+            "router_port": fleet.router_port,
+            "latencies": latencies,
+        }
+
+    big = legs[max_workers]
+    workers_final = big["final"]["workers"]
+    ratio = (
+        round(big["qps"] / legs[1]["qps"], 3)
+        if len(worker_counts) > 1 and legs[1]["qps"] > 0
+        else None
+    )
+    total_recompiles = sum(
+        f.get("recompiles_after_warmup", 0)
+        for leg in legs.values()
+        for f in leg["final"]["workers"].values()
+    )
+    merged_fallbacks = {}
+    shared_sig_fallbacks = 0
+    for f in workers_final.values():
+        for reason, cnt in f.get("score_fallback_reasons", {}).items():
+            merged_fallbacks[reason] = merged_fallbacks.get(reason, 0) + cnt
+        # every spec shares ONE signature, so any worker hosting >= 2
+        # tenants must ground them all in one group — any fallback there
+        # means the grouping broke
+        if len(f.get("tenants", [])) >= 2:
+            shared_sig_fallbacks += sum(
+                f.get("score_fallback_reasons", {}).values()
+            )
+    all_lat = sorted(
+        lat for per in big["latencies"].values() for lat in per
+    )
+
+    def _pct(q):
+        return round(all_lat[min(int(q * len(all_lat)), len(all_lat) - 1)] * 1e3, 3)
+
+    metrics_out = getattr(args, "metrics_out", None)
+    if metrics_out:
+        writer = telemetry.MetricsWriter(metrics_out)
+        for wid, f in sorted(workers_final.items()):
+            writer.event(
+                "fleet_worker",
+                worker=wid,
+                workers=max_workers,
+                tenants=len(f.get("tenants", [])),
+                qps=round(f.get("queries", 0) / big["wall"], 2),
+                p99_ms=f.get("p99_ms"),
+                groups=len(f.get("score_groups", [])),
+                fallbacks=sum(f.get("score_fallback_reasons", {}).values()),
+            )
+
+    return {
+        "serve_fleet_qps": big["qps"],
+        "serve_fleet_workers": max_workers,
+        "serve_fleet_worker_counts": worker_counts,
+        "serve_fleet_tenants": T,
+        "serve_fleet_queries": total_queries,
+        "serve_fleet_queries_served": big["served"],
+        "serve_fleet_qps_by_workers": {
+            str(n): legs[n]["qps"] for n in worker_counts
+        },
+        "fleet_qps_scaling_ratio": ratio,
+        "serve_fleet_p50_ms": _pct(0.50),
+        "serve_fleet_p99_ms": _pct(0.99),
+        "serve_fleet_warmup_seconds_by_workers": {
+            str(n): legs[n]["warmup"] for n in worker_counts
+        },
+        # THE gates: zero jit-cache growth past warmup on EVERY worker of
+        # EVERY leg (in-process tally + the HTTP-scraped counter twin), and
+        # zero fallbacks among tenants whose signature is shared on their
+        # worker (the grouped-stacking acceptance criterion).
+        "serve_fleet_recompiles_after_warmup": total_recompiles,
+        "serve_fleet_worker_recompiles": {
+            wid: f.get("recompiles_after_warmup")
+            for wid, f in sorted(workers_final.items())
+        },
+        "serve_fleet_worker_recompile_metric": big["worker_recompile_metric"],
+        "serve_fleet_score_fallback_reasons": merged_fallbacks,
+        "serve_fleet_shared_sig_fallbacks": shared_sig_fallbacks,
+        "serve_fleet_groups": {
+            wid: f.get("score_groups", [])
+            for wid, f in sorted(workers_final.items())
+        },
+        "serve_fleet_group_count": sum(
+            len(f.get("score_groups", [])) for f in workers_final.values()
+        ),
+        "serve_fleet_batched_score_launches": sum(
+            f.get("batched_score_launches", 0)
+            for f in workers_final.values()
+        ),
+        "serve_fleet_router": big["final"]["router"],
+        "serve_fleet_rerouted": (big["final"]["router"] or {}).get("rerouted"),
+        "serve_fleet_unroutable": (
+            (big["final"]["router"] or {}).get("unroutable")
+        ),
+        "serve_fleet_router_metrics_aggregated": big["agg_ok"],
+        "serve_fleet_router_healthy": big["health_ok"],
+        "ops_port": big["router_port"],
+    }
+
+
 def bench_lal(args):
     """One LAL query at reference scale: 50-tree base forest, 2000-tree
     regressor, 1000-point pool (``classes/RESULTS.txt``)."""
@@ -2245,6 +2490,23 @@ def _run_mode(args) -> dict:
             # asserts tenants/recompiles/growth-compile events by name
             **r,
         }
+    if args.mode == "serve-fleet":
+        r = _run_bench("serve_fleet", bench_serve_fleet, args)
+        return {
+            "metric": "serve_fleet_qps",
+            "value": r["serve_fleet_qps"],
+            "unit": (
+                f"score queries/s through the consistent-hash router across "
+                f"{r['serve_fleet_workers']} shared-nothing workers "
+                f"({r['serve_fleet_tenants']} tenants, "
+                f"{r['serve_fleet_queries']} queries, scaling ratio "
+                f"{r['fleet_qps_scaling_ratio']} vs 1 worker)"
+            ),
+            "vs_baseline": None,
+            # the full key set rides too: the CI serve-fleet smoke job
+            # asserts qps/per-worker recompiles/shared-sig fallbacks by name
+            **r,
+        }
     if args.mode == "round":
         r = _run_bench("round", bench_round, args)
         return {
@@ -2482,6 +2744,7 @@ _TPU_SIZES = dict(
     serve_queries=2000,
     serve_pool=8192,
     serve_tenants=4,
+    fleet_workers=4,
 )
 _CPU_SIZES = dict(
     pool=10_000,
@@ -2499,6 +2762,7 @@ _CPU_SIZES = dict(
     serve_queries=220,
     serve_pool=256,
     serve_tenants=4,
+    fleet_workers=4,
 )
 
 
@@ -2601,9 +2865,14 @@ def _audit_gate(
         ), lal_pool or pool_rows))
     if mode in ("all", "serve"):
         groups.append((dict(kinds=["serve"], placements=placements), serve_pool))
-    if mode in ("all", "serve-multi"):
+    if mode in ("all", "serve-multi", "serve-fleet"):
         groups.append((dict(
             kinds=["serve_multi"], placements=placements,
+        ), serve_pool))
+        # the signature-grouped stacked score program (the grouped fast
+        # path every fleet worker serves from) — cpu-only in the registry
+        groups.append((dict(
+            kinds=["serve_group"], placements=["cpu"],
         ), serve_pool))
     mem_table, mem_findings = {}, []
     for kwargs, rows in groups:
@@ -2637,7 +2906,7 @@ def main():
         "--mode",
         choices=[
             "all", "score", "density", "round", "sweep", "grid", "serve",
-            "serve-multi", "lal", "neural",
+            "serve-multi", "serve-fleet", "lal", "neural",
         ],
         default="all",
     )
@@ -2697,6 +2966,19 @@ def main():
         help="serve-multi mode: resident tenants sharing the process "
         "(backend-resolved default 4; the acceptance floor); total queries "
         "= --serve-queries split across tenants, one client thread each",
+    )
+    ap.add_argument(
+        "--fleet-workers", type=int, default=None,
+        help="serve-fleet mode: worker processes in the scaled leg (default "
+        "4; the bench always runs a 1-worker leg first for "
+        "fleet_qps_scaling_ratio)",
+    )
+    ap.add_argument(
+        "--fleet-linger", type=float, default=None,
+        help="serve-fleet mode: hold the max-workers fleet up for this many "
+        "seconds after its traffic completes so an external scraper can hit "
+        "the router (--ops-port) and each worker's /metrics mid-run "
+        "(default: the DAL_FLEET_LINGER env var, else 0)",
     )
     ap.add_argument(
         "--profile-dir", default=None, metavar="DIR",
@@ -2762,7 +3044,9 @@ def main():
         "/metrics Prometheus text, /healthz, /varz, /flightz) on "
         "localhost:PORT for the whole run so it can be scraped mid-flight; "
         "absent = an ephemeral port (the bench's self-scrape sidecar uses "
-        "it either way and reports ops_scrapes)",
+        "it either way and reports ops_scrapes). serve-fleet mode: pins the "
+        "ROUTER port for the max-workers leg instead (workers keep "
+        "ephemeral ops ports, discoverable via the router's /workers)",
     )
     ap.add_argument(
         "--deadline", type=float, default=None,
@@ -2782,6 +3066,8 @@ def main():
         args.deadline = float(os.environ.get("DAL_BENCH_DEADLINE", "420"))
     if args.deadline <= 0:
         args.deadline = None
+    if args.fleet_linger is None:
+        args.fleet_linger = float(os.environ.get("DAL_FLEET_LINGER", "0"))
 
     # An outer `timeout` SIGTERMs before it SIGKILLs; turn that (and Ctrl-C)
     # into an unwind through the JSON printer below. Installed BEFORE the

@@ -96,6 +96,13 @@ DEFAULT_SPECS: List[MetricSpec] = [
     MetricSpec("serve_multi_p50_ms", "lower", 0.40),
     MetricSpec("serve_multi_worst_tenant_p99_ms", "lower", 0.50),
     MetricSpec("serve_multi_ingest_points_per_sec", "higher", 0.30),
+    # shared-nothing fleet (PR 20): router-path qps at max workers, the
+    # 1 -> N scaling ratio the mode exists to measure (loose — CPU CI
+    # runners share cores with the workers), and the per-query tail over
+    # the binary keep-alive wire
+    MetricSpec("serve_fleet_qps", "higher", 0.30),
+    MetricSpec("fleet_qps_scaling_ratio", "higher", 0.50),
+    MetricSpec("serve_fleet_p99_ms", "lower", 0.50),
     MetricSpec("lal_query_seconds", "lower", 0.30),
     MetricSpec("lal_query_device_seconds", "lower", 0.30),
     MetricSpec("cnn_round_seconds", "lower", 0.40),
@@ -139,6 +146,17 @@ DEFAULT_SPECS: List[MetricSpec] = [
         "serve_multi_growth_compile_events", "lower", 0.0, kind="counter",
         hard=True,
     ),
+    # fleet twins: a post-warmup recompile on ANY worker, or a resident
+    # tenant falling off the grouped stacked path on a multi-tenant worker,
+    # is an architectural regression — never CPU-runner noise
+    MetricSpec(
+        "serve_fleet_recompiles_after_warmup", "lower", 0.0, kind="counter",
+        hard=True,
+    ),
+    MetricSpec(
+        "serve_fleet_shared_sig_fallbacks", "lower", 0.0, kind="counter",
+        hard=True,
+    ),
     # live ops plane (PR 15): SLO compliance is an architectural ratio, not
     # rig noise — the serve-multi smoke objective is deliberately generous
     # (10s at target 0.95), so a >5% drop means queries stopped finishing:
@@ -169,6 +187,7 @@ VALUE_DIRECTIONS = {
     "grid_cells_rounds_per_second": "higher",
     "serve_qps": "higher",
     "serve_multi_qps": "higher",
+    "serve_fleet_qps": "higher",
     "al_round_seconds": "lower",
     "lal_query_seconds": "lower",
     "neural_round_seconds": "lower",

@@ -30,6 +30,10 @@ Sections:
   SLOTracker), cross-checked against the latency stream: a tenant that has
   serve_latency events but NO configured SLO gets a loud note — unmonitored
   traffic is the gap this table exists to name;
+- **fleet** — per-worker attribution from the worker-tagged ``fleet_worker``
+  events (bench.py --mode serve-fleet --metrics-out): which worker served
+  how many tenants at what qps/p99, its resident group count, and any
+  fallbacks off the grouped stacked path;
 - **roofline** — per-program cost attribution events (run.py --roofline):
   flops/bytes, achieved rates, MFU, bound verdict;
 - **counters / gauges** — host transfer bytes, device memory watermarks.
@@ -533,6 +537,45 @@ def summarize(events: List[dict]) -> str:
             "\nNOTE: tenant(s) with serve_latency events but NO SLO "
             f"configured: {', '.join(unmonitored)} — their latency is "
             "unmonitored traffic (set ServeConfig.slo_latency_ms)"
+        )
+
+    # Fleet table (bench.py --mode serve-fleet emits one worker-tagged
+    # `fleet_worker` event per worker on the max-workers leg): per-worker
+    # qps/p99 attribution plus the grouped-stacking health columns — group
+    # count and fallbacks-off-the-stacked-path, which the fleet acceptance
+    # gate holds at zero. Defensive like the serve tables: an event missing
+    # its worker tag or carrying a non-numeric qps is skipped, never a crash.
+    fleet_workers = [
+        e for e in events
+        if e.get("kind") == "fleet_worker"
+        and "worker" in e
+        and _num(e, "qps") is not None
+    ]
+    if fleet_workers:
+        rows = []
+        for e in sorted(fleet_workers, key=lambda e: str(e["worker"])):
+            def _fi(key):
+                v = _num(e, key)
+                return int(v) if v is not None else "-"
+
+            p99 = _num(e, "p99_ms")
+            rows.append([
+                str(e["worker"]),
+                _fi("tenants"),
+                f"{e['qps']:.2f}",
+                f"{p99:.3f}" if p99 is not None else "-",
+                _fi("groups"),
+                _fi("fallbacks"),
+            ])
+        workers_n = {str(e.get("workers")) for e in fleet_workers}
+        out.append(
+            "\n== fleet ==\n"
+            + f"{len(fleet_workers)} workers (fleet size "
+            + "/".join(sorted(workers_n)) + ")\n"
+            + _table(
+                ["worker", "tenants", "qps", "p99 ms", "groups", "fallbacks"],
+                rows,
+            )
         )
 
     rooflines = [e for e in events if e.get("kind") == "roofline"]

@@ -76,7 +76,8 @@ FIT_BUDGET = 48
 KINDS = (
     "chunk", "fused_chunk", "fused_select", "pod_select", "pod_ingest",
     "sweep", "grid",
-    "neural_sweep", "neural_chunk", "serve", "serve_multi", "scenario",
+    "neural_sweep", "neural_chunk", "serve", "serve_multi", "serve_group",
+    "scenario",
 )
 GRID_D = 2   # datasets in the audited grid program
 GRID_E = 2   # seeds per (strategy, dataset)
@@ -1026,6 +1027,48 @@ def serve_multi_program_names() -> List[str]:
     return ["batched_score", "chunk", "ingest"]
 
 
+def _build_serve_group(program: str, placement: str) -> AuditUnit:
+    """The signature-grouped resident stacked score programs
+    (serving/tenants.py ``_ScoreGroup``): tenants sharing a forest
+    signature are restacked into ONE forest pytree with a leading group
+    axis and served by one vmapped launch. Group SIZE is an aval axis —
+    every distinct resident cardinality is its own executable — so the
+    audit prices the small cardinalities the fleet smoke actually serves
+    (2- and 3-tenant groups) rather than only the fixed serve_multi/T=2
+    shape. cpu-only: a group stacks forests resident on one worker."""
+    from distributed_active_learning_tpu.serving import tenants as tenants_lib
+
+    if placement != "cpu":
+        raise SkipProgram(
+            "a signature group stacks same-signature forests resident on "
+            "one worker process; no mesh variant"
+        )
+    sizes = {"stacked_score_g2": 2, "stacked_score_g3": 3}
+    if program not in sizes:
+        raise ValueError(f"unknown serve_group program {program!r}")
+    g = sizes[program]
+    forest = jax.eval_shape(
+        _device_fit("gemm"),
+        _sds((POOL_ROWS, FEATURES), jnp.int32),
+        _abstract_state(),
+        _key_sds(),
+    )
+    stacked = jax.tree.map(
+        lambda l: _sds((g,) + tuple(l.shape), l.dtype), forest
+    )
+    args = (stacked, _sds((g, SERVE_SCORE_WIDTH, FEATURES), jnp.float32))
+    return AuditUnit(
+        name=f"serve_group/{program}/{placement}",
+        fn=tenants_lib.make_batched_score_fn(),
+        args=args,
+        expect_donation=False,
+    )
+
+
+def serve_group_program_names() -> List[str]:
+    return ["stacked_score_g2", "stacked_score_g3"]
+
+
 def _scenario_audit_cfg(program: str):
     """The representative ScenarioConfig each scenario audit program runs
     under — nonzero probabilities/rates so every scenario branch actually
@@ -1188,6 +1231,10 @@ def build_registry(
         # both placements (the grid machinery shards); batched_score/ingest
         # skip mesh with a named reason inside the builder
         ("serve_multi", _build_serve_multi, serve_multi_program_names()),
+        # the signature-grouped stacked score path at its resident group
+        # cardinalities — each group size is a distinct executable the
+        # fleet workers serve from
+        ("serve_group", _build_serve_group, serve_group_program_names()),
         # the scenario engine's round variants (noisy reveal, knapsack
         # select, drifted eval, rare metric) + the standalone knapsack
         # kernel — the donation/carry invariants of the clean chunk must
@@ -1202,7 +1249,7 @@ def build_registry(
         # pod_select/pod_ingest are the inverse (mesh placements only)
         if kind in (
             "neural_sweep", "neural_chunk", "serve", "fused_select",
-            "scenario",
+            "scenario", "serve_group",
         ):
             kind_placements = ("cpu",) if "cpu" in placements else ()
         elif kind in ("pod_select", "pod_ingest"):

@@ -264,6 +264,17 @@ class ServeConfig:
     # default) disables SLO accounting entirely.
     slo_latency_ms: float = 0.0
     slo_target: float = 0.99
+    # Burn-rate-driven admission (serving/frontend.py): when the tenant's
+    # 5-minute burn rate (SLOTracker — bad_frac / error budget; 1.0 = the
+    # budget is being spent exactly at the sustainable rate) reaches this
+    # threshold, NEW score submissions are shed at admission with
+    # AdmissionError — the SLO is already lost for this window, so refusing
+    # early keeps the doomed tenant's queue from delaying healthy ones.
+    # Ingest is never shed (fresh data is how a burning tenant recovers).
+    # <= 0 (the default) disables shedding; independent of the always-on
+    # dispatch deprioritization, which scales a burning tenant's effective
+    # slo_weight by 1 / (1 + burn) once burn >= 1.
+    burn_shed_threshold: float = 0.0
 
 
 @dataclasses.dataclass(frozen=True)

@@ -13,22 +13,33 @@
   control, per-tenant fairness, re-fit backpressure, one dispatcher thread
   owning all device work;
 - :mod:`serving.service` — the single-tenant compatibility front
-  (:class:`ALService` routes through a 1-tenant manager).
+  (:class:`ALService` routes through a 1-tenant manager);
+- :mod:`serving.fleet` — the shared-nothing multi-process fleet: N worker
+  processes (each a full manager + frontend + ops plane) behind a
+  consistent-hash router with health-gated forwarding.
 
 Entry points: ``python -m distributed_active_learning_tpu.serving`` (a
 simulated stream over a registry dataset), ``bench.py --mode serve`` (the
-single-tenant sustained-qps / p99-latency benchmark) and ``bench.py --mode
+single-tenant sustained-qps / p99-latency benchmark), ``bench.py --mode
 serve-multi`` (>= 4 tenants under mixed ingest + re-fit load, per-tenant
-p50/p99, the zero-growth-compile gate).
+p50/p99, the zero-growth-compile gate) and ``bench.py --mode serve-fleet``
+(the 1 -> 4 worker scaling leg behind the router).
 """
 
 from distributed_active_learning_tpu.serving.drift import DriftMonitor  # noqa: F401
+from distributed_active_learning_tpu.serving.fleet import (  # noqa: F401
+    Fleet,
+    HashRing,
+    RouterServer,
+    TenantSpec,
+)
 from distributed_active_learning_tpu.serving.frontend import (  # noqa: F401
     AdmissionError,
     ServiceFrontend,
 )
 from distributed_active_learning_tpu.serving.service import ALService  # noqa: F401
 from distributed_active_learning_tpu.serving.slab import (  # noqa: F401
+    RebalanceHysteresis,
     SlabPool,
     flat_state,
     grow_slab,

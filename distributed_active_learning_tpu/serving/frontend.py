@@ -34,6 +34,17 @@ every device-touching operation through ONE dispatcher thread.
   requests before :class:`AdmissionError`, so under global load the lower
   classes shed first and the gold class keeps enqueueing.
 
+- **Burn-rate-driven admission.** The first consumer that ACTS on the
+  PR-15 burn gauges: a tenant whose 5-minute SLO burn rate
+  (:meth:`~runtime.obs.SLOTracker.burn_rate`) reaches 1.0 has its effective
+  WRR weight scaled by ``1 / (1 + burn)`` (dispatch deprioritization,
+  always on), and once the burn crosses ``ServeConfig.burn_shed_threshold``
+  (> 0 to enable) new SCORE submissions are refused with
+  :class:`AdmissionError` before they queue — the SLO is already lost for
+  the window, so shedding early keeps healthy tenants from waiting behind a
+  doomed queue. Ingest is never burn-shed: fresh data is how a burning
+  tenant recovers.
+
 - **Re-fit backpressure.** While a tenant's re-fit chunk is in flight its
   INGEST requests are held (the slab arrays are donation-bound to the
   running chunk's output futures; piling more device writes behind a
@@ -112,6 +123,12 @@ class ServiceFrontend:
         self._credits: Dict[str, float] = {}
         self.slo_served: Dict[str, int] = {}
         self.slo_deferred: Dict[str, int] = {}
+        # Burn-rate-driven admission/dispatch (the first consumer that ACTS
+        # on the PR-15 burn gauges): score submissions shed at admission
+        # while the 5m burn says the SLO is already lost, and dispatch
+        # cycles where the deficit WRR deprioritized a burning tenant.
+        self.burn_shed: Dict[str, int] = {}
+        self.burn_deprioritized: Dict[str, int] = {}
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -165,15 +182,38 @@ class ServiceFrontend:
         prio = max(int(getattr(serve, "slo_priority", 0)), 0)
         return base * (1 + prio)
 
+    def _burn5(self, tenant: str) -> Optional[float]:
+        """The tenant's 5-minute SLO burn rate, or None when the tracker has
+        no observations in the window (a fresh or idle tenant is NOT
+        burning)."""
+        slo = getattr(self.manager.tenant(tenant), "slo", None)
+        if slo is None:
+            return None
+        return slo.burn_rate(300.0)
+
     def _credit_ok(self, tenant: str) -> bool:
         """Deficit weighted round-robin: accrue ``slo_weight`` credits per
         contended cycle, spend 1 per score slot. Called at most once per
         tenant per dispatch cycle (and only when a score is actually
         queued), so the accrual rate IS the cycle rate. Weight >= 1 is
         always served (the pre-SLO behavior for the default 1.0); weight w
-        in (0, 1) is served a w fraction of its contended cycles."""
+        in (0, 1) is served a w fraction of its contended cycles.
+
+        Burn deprioritization: once the 5m burn rate reaches 1.0 (the error
+        budget is being spent faster than sustainable), the tenant's
+        effective weight is scaled by ``1 / (1 + burn)`` — a tenant burning
+        at 2x accrues a third of its configured credits, so healthy tenants'
+        slots stop queueing behind one that is already missing its SLO. The
+        scale is continuous in the burn rate (no cliff at the threshold) and
+        recovers automatically as good observations re-enter the window."""
         serve = self.manager.tenant(tenant).serve
         w = max(float(getattr(serve, "slo_weight", 1.0)), 0.0)
+        burn = self._burn5(tenant)
+        if burn is not None and burn >= 1.0:
+            w = w / (1.0 + min(burn, 100.0))
+            self.burn_deprioritized[tenant] = (
+                self.burn_deprioritized.get(tenant, 0) + 1
+            )
         c = min(self._credits.get(tenant, 0.0) + w, max(1.0, w))
         if c >= 1.0:
             self._credits[tenant] = c - 1.0
@@ -185,6 +225,30 @@ class ServiceFrontend:
 
     def _enqueue(self, req: _Request) -> Future:
         cap = self._cap_for(req.tenant)
+        serve = self.manager.tenant(req.tenant).serve
+        shed_at = float(getattr(serve, "burn_shed_threshold", 0.0))
+        if req.kind == "score" and shed_at > 0.0:
+            # Burn shedding: past the configured 5m burn rate the SLO is
+            # already lost for this window — refusing new SCORE work early
+            # keeps the doomed tenant's queue from delaying healthy ones.
+            # Ingest is never shed: fresh data is how a burning tenant
+            # recovers.
+            burn = self._burn5(req.tenant)
+            if burn is not None and burn >= shed_at:
+                self.burn_shed[req.tenant] = (
+                    self.burn_shed.get(req.tenant, 0) + 1
+                )
+                obs.counter(
+                    "admission_burn_sheds",
+                    "score submissions shed because the 5m SLO burn rate "
+                    "crossed burn_shed_threshold",
+                    tenant=req.tenant,
+                ).inc()
+                raise AdmissionError(
+                    f"tenant {req.tenant!r} shed at admission: 5m burn rate "
+                    f"{burn:.2f} >= burn_shed_threshold {shed_at:.2f}; the "
+                    f"SLO budget is exhausted — retry after the window cools"
+                )
         with self._cond:
             if not self._running:
                 raise RuntimeError("frontend is not running (call start())")

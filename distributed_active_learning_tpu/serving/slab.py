@@ -34,7 +34,7 @@ memory is allocated" from "how much of it is real":
 from __future__ import annotations
 
 import functools
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -464,6 +464,98 @@ def rebalance_trigger(fills, ratio: float = 2.0) -> bool:
     if f.min() == 0:
         return True
     return float(f.max()) / float(f.min()) > ratio
+
+
+def _fill_ratio(fills) -> Optional[float]:
+    """max/min shard fill as a float (inf when an empty shard sits next to
+    a non-empty one), or None when the vector can't be imbalanced."""
+    f = np.asarray(fills)
+    if f.size <= 1 or f.max() == 0:
+        return None
+    if f.min() == 0:
+        return float("inf")
+    return float(f.max()) / float(f.min())
+
+
+class RebalanceHysteresis:
+    """Thrash-proof epoch trigger wrapping :func:`rebalance_trigger`.
+
+    The bare fill-ratio threshold is instantaneous: an adversarial arrival
+    pattern that keeps the ratio oscillating around the threshold fires an
+    epoch on every check, and each window-sized epoch only partially
+    corrects the skew it was fired for — the classic rebalance thrash. This
+    stateful trigger fixes both failure modes:
+
+    - **Enter/exit band.** The trigger becomes ACTIVE when the ratio
+      exceeds ``enter_ratio`` and stays active until the ratio drops to
+      ``exit_ratio`` or below — so once a skew is being worked, epochs keep
+      firing until the pool is genuinely balanced (not merely back under
+      the entry threshold), and a ratio hovering just below ``enter_ratio``
+      after recovery fires nothing.
+
+    - **Minimum inter-epoch interval.** While active, at most one fire per
+      ``min_interval`` calls to :meth:`update` — callers check once per
+      ingest step, so this is a step-denominated rate limit that gives each
+      epoch's moves time to land before the next is cut.
+
+    Call :meth:`update` with the current fill vector once per step; it
+    returns True exactly when an epoch should run now. ``fired`` /
+    ``suppressed_interval`` / ``suppressed_band`` count decisions for
+    observability and tests.
+    """
+
+    def __init__(
+        self,
+        enter_ratio: float = 2.0,
+        exit_ratio: float = 1.5,
+        min_interval: int = 4,
+    ):
+        if exit_ratio > enter_ratio:
+            raise ValueError(
+                f"exit_ratio ({exit_ratio}) must not exceed enter_ratio "
+                f"({enter_ratio}) — the band would invert"
+            )
+        self.enter_ratio = float(enter_ratio)
+        self.exit_ratio = float(exit_ratio)
+        self.min_interval = int(min_interval)
+        self._active = False
+        # Primed so the FIRST excursion past enter_ratio fires immediately;
+        # the interval gates consecutive fires, not the initial response.
+        self._since_fire = self.min_interval
+        self.fired = 0
+        self.suppressed_interval = 0
+        self.suppressed_band = 0
+
+    @property
+    def active(self) -> bool:
+        """True while the trigger is between enter and exit — epochs fire
+        (subject to the interval) until the ratio drops to ``exit_ratio``."""
+        return self._active
+
+    def update(self, fills) -> bool:
+        """Advance one step with the current ``[S]`` fill vector; True means
+        run a rebalance epoch now."""
+        self._since_fire += 1
+        ratio = _fill_ratio(fills)
+        if ratio is None:
+            self._active = False
+            return False
+        if self._active and ratio <= self.exit_ratio:
+            self._active = False
+        if not self._active and ratio > self.enter_ratio:
+            self._active = True
+        if not self._active:
+            if ratio > self.exit_ratio:
+                # inside the band but not entered from above — the
+                # hysteresis is doing its job
+                self.suppressed_band += 1
+            return False
+        if self._since_fire < self.min_interval:
+            self.suppressed_interval += 1
+            return False
+        self._since_fire = 0
+        self.fired += 1
+        return True
 
 
 def make_rebalance_fn(mesh, block_rows: int):

@@ -16,15 +16,21 @@ generalizes the single-tenant event loop into three load-bearing pieces:
   fused paths:
 
   * **Batched scoring** (:meth:`TenantManager.score_many`): concurrent score
-    requests from different tenants coalesce into ONE launch —
+    requests from different tenants coalesce into fused launches —
     :func:`make_batched_score_fn` vmaps the shared
-    :func:`~serving.slab.score_body` over a leading tenant axis. The tenant
-    axis is PADDED to the full resident set (absent tenants ride as zero-row
-    no-ops, per-tenant ``n_valid`` watermarks mask them out at unstack), so
-    request-subset churn never changes the program's avals — the same
-    discipline the slab pool applies to arrivals. Requires structurally
-    identical forests (same n_trees/depth/quantize/kernel); mismatches fall
-    back to per-tenant launches with a NAMED reason in the summary.
+    :func:`~serving.slab.score_body` over a leading tenant axis. Resident
+    tenants are partitioned into SAME-SIGNATURE GROUPS (forest structure x
+    score width x feature width); each group keeps a RESIDENT stacked
+    forest and its own stacked score program (:class:`_ScoreGroup` —
+    restacked only on a member's re-fit touchdown or a membership change,
+    never per dispatch) and dispatches one vmapped launch per width-round.
+    Each group's tenant axis is PADDED to its full membership (absent
+    tenants ride as zero-row no-ops, per-tenant ``n_valid`` watermarks mask
+    them out at unstack), so request-subset churn never changes a program's
+    avals — the same discipline the slab pool applies to arrivals. Only
+    tenants no group can hold — a signature shared with NO other resident,
+    an unbatchable kernel, a single-tenant manager — fall back to
+    per-tenant launches, each with a NAMED reason in the summary.
 
   * **Batched re-fit** (tenant-axis chunk): when several same-configuration
     tenants' drift monitors fire together, their re-fit chunks launch as ONE
@@ -234,6 +240,41 @@ def make_batched_score_fn():
             return jax.vmap(slab_lib.score_body)(forests, queries)
 
     return score
+
+
+class _ScoreGroup:
+    """One same-signature resident group of the fused score path.
+
+    The group key is everything the stacked program's avals depend on —
+    forest signature, ``score_width``, feature width — so every member can
+    ride one ``[G, W, d]`` vmapped launch. The stacked forest is RESIDENT:
+    it is rebuilt only when a member's re-fit touches down (``dirty``) or
+    when group membership changes (the manager then builds a fresh group),
+    never per dispatch. Each group owns its score program instance, so the
+    jit cache (and the recompile count) is per group: a stable group
+    compiles exactly once.
+    """
+
+    def __init__(self, key: tuple, tids: List[str], metrics):
+        self.key = key
+        self.tids = list(tids)  # registration order — the stable tenant axis
+        self.fn = make_batched_score_fn()
+        # Same program name as the pre-grouping single stacked program: the
+        # obs series (launches/seconds/recompiles tagged
+        # program="serve_batched_score") keep their CI-gated family names;
+        # per-group attribution rides the launch events' ``tenants`` extra.
+        self.tracker = _ProgramTracker(metrics, "serve_batched_score", self.fn)
+        self.stacked = None
+        self.dirty = True
+        self.launches = 0
+
+    @property
+    def width(self) -> int:
+        return self.key[1]
+
+    @property
+    def features(self) -> int:
+        return self.key[2]
 
 
 class Tenant:
@@ -1134,7 +1175,7 @@ class Tenant:
         )
         progs.fit_tracker.record(time.perf_counter() - t0)
         if self._manager is not None:
-            self._manager._mark_forest_dirty()
+            self._manager._mark_forest_dirty(self.tenant_id)
 
     # -- persistence ---------------------------------------------------------
 
@@ -1372,7 +1413,8 @@ class TenantManager:
     - ``add_tenant`` makes a dataset x model resident (restoring from the
       tenant-axis serve checkpoint when one exists);
     - ``score_many`` fuses concurrent score requests into one vmapped launch
-      (per-tenant fallback with a named reason when forests can't stack);
+      per same-signature group (per-tenant fallback with a named reason only
+      for tenants no group can hold);
     - drift-triggered re-fits from same-configuration tenants coalesce into
       one tenant-axis grid-chunk launch;
     - slab growth swaps in background-AOT-compiled executables instead of
@@ -1384,14 +1426,16 @@ class TenantManager:
         self.checkpoint_dir = checkpoint_dir
         self._tenants: Dict[str, Tenant] = {}
         self._lock = threading.RLock()
-        # batched scoring
-        self._batched_score_fn = make_batched_score_fn()
-        self._batched_score_tracker = _ProgramTracker(
-            metrics, "serve_batched_score", self._batched_score_fn
-        )
-        self._stacked_forest = None
-        self._stacked_dirty = True
-        self._batched_reason_cache: Optional[Tuple[Optional[str]]] = None
+        # batched scoring: same-signature groups, each with a RESIDENT
+        # stacked forest and its own stacked score program (rebuilt only on
+        # membership change; restacked only on re-fit touchdown).
+        self._score_groups: Optional[Dict[tuple, _ScoreGroup]] = None
+        self._prev_score_groups: Dict[tuple, _ScoreGroup] = {}
+        self._score_fallback_by_tid: Dict[str, str] = {}
+        # recompiles counted by groups a membership change retired — the
+        # headline recompiles_after_warmup must never forget a recompile
+        # just because its program instance was replaced
+        self._retired_group_recompiles = 0
         self.batched_score_launches = 0
         self.score_fallback_reasons: Dict[str, int] = {}
         # tenant-axis batched re-fit
@@ -1459,8 +1503,9 @@ class TenantManager:
                 manager=self,
             )
             self._tenants[tenant_id] = tenant
-            self._stacked_dirty = True
-            self._batched_reason_cache = None
+            # membership changed: repartition the fused score path (groups
+            # whose membership survives keep their program + resident stack)
+            self._score_groups = None
         if self.metrics is not None:
             self.metrics.event(
                 "tenant_added", tenant=tenant_id,
@@ -1490,62 +1535,105 @@ class TenantManager:
     def submit(self, tenant_id: str, x, y) -> None:
         self._tenants[tenant_id].submit(x, y)
 
-    def _batched_score_reason(self) -> Optional[str]:
-        """None when the cross-tenant fused path may serve; a named fallback
-        reason otherwise (recorded in the summary, never silent). The cache
-        fill runs on the dispatcher thread while ``add_tenant`` invalidates
-        under the manager lock from a client thread — same lock here, or a
-        stale reason serves the wrong path (flagged by DAL201)."""
-        with self._lock:
-            if self._batched_reason_cache is not None:
-                return self._batched_reason_cache[0]
-            reason = None
-            tenants = list(self._tenants.values())
-            if len(tenants) < 2:
-                reason = "single_tenant"
-            elif len({t._forest_sig for t in tenants}) > 1:
-                reason = "forest_structure"
-            elif any(
-                t.cfg.forest.kernel not in _BATCHABLE_KERNELS for t in tenants
-            ):
-                reason = "kernel"
-            elif len({t.serve.score_width for t in tenants}) > 1:
-                reason = "score_width"
-            elif len({int(t._slab.x.shape[1]) for t in tenants}) > 1:
-                reason = "feature_width"
-            self._batched_reason_cache = (reason,)
-            return reason
+    def _tenant_group_key(self, t: Tenant) -> tuple:
+        """Everything the stacked score program's avals depend on: tenants
+        agreeing on this tuple can share one vmapped launch."""
+        return (t._forest_sig, t.serve.score_width, int(t._slab.x.shape[1]))
 
-    def _mark_forest_dirty(self) -> None:
+    def _score_grouping(
+        self,
+    ) -> Tuple[Dict[tuple, "_ScoreGroup"], Dict[str, str]]:
+        """The resident partition of the fused score path: same-signature
+        groups of >= 2 (each with its resident stacked forest + program) and
+        a per-tenant NAMED fallback reason for everyone else. Rebuilt only
+        when the tenant set changes (``add_tenant`` invalidates); a rebuild
+        reuses any group whose key AND membership survived, so stable groups
+        keep their compiled program and resident stack. The rebuild runs on
+        the dispatcher thread while ``add_tenant`` invalidates under the
+        manager lock from a client thread — same lock here, or a stale
+        partition serves the wrong path (flagged by DAL201)."""
         with self._lock:
-            self._stacked_dirty = True
+            if self._score_groups is not None:
+                return self._score_groups, self._score_fallback_by_tid
+            members: Dict[tuple, List[str]] = {}
+            fallback: Dict[str, str] = {}
+            single = len(self._tenants) < 2
+            for tid, t in self._tenants.items():
+                if t.cfg.forest.kernel not in _BATCHABLE_KERNELS:
+                    fallback[tid] = "kernel"
+                    continue
+                members.setdefault(self._tenant_group_key(t), []).append(tid)
+            prev = self._prev_score_groups
+            groups: Dict[tuple, _ScoreGroup] = {}
+            for key, tids in members.items():
+                if single:
+                    fallback[tids[0]] = "single_tenant"
+                elif len(tids) < 2:
+                    # structurally alone among the residents: sharing would
+                    # need another tenant with this signature
+                    fallback[tids[0]] = "singleton_signature"
+                else:
+                    old = prev.get(key)
+                    if old is not None and old.tids == tids:
+                        groups[key] = old  # program + resident stack survive
+                    else:
+                        groups[key] = _ScoreGroup(key, tids, self.metrics)
+            for key, old in prev.items():
+                if groups.get(key) is not old:
+                    self._retired_group_recompiles += old.tracker.recompiles
+            self._prev_score_groups = groups
+            self._score_groups = groups
+            self._score_fallback_by_tid = fallback
+            return groups, fallback
 
-    def _stacked(self):
+    def score_groups(self) -> List[List[str]]:
+        """The current same-signature groups riding the fused path (tenant
+        ids in registration order) — the observable the fleet bench and the
+        summary report."""
+        groups, _ = self._score_grouping()
+        return [list(g.tids) for g in groups.values()]
+
+    def _mark_forest_dirty(self, tenant_id: Optional[str] = None) -> None:
+        """A re-fit touchdown moved ``tenant_id``'s resident forest: restack
+        that tenant's group before its next fused launch (None = all groups;
+        the conservative path for callers that predate per-group dirt)."""
+        with self._lock:
+            if self._score_groups is None:
+                return  # next _score_grouping() stacks fresh anyway
+            for g in self._score_groups.values():
+                if tenant_id is None or tenant_id in g.tids:
+                    g.dirty = True
+
+    def _stacked_for(self, group: "_ScoreGroup"):
         # The re-stack must be ATOMIC with the dirty flag (a touchdown
         # marking dirty mid-stack would be lost); the stack itself is a
         # dispatch under the manager lock, which is the accepted cost here —
         # one dispatcher thread by design, and RLock re-entry keeps the
         # score path cheap when the cache is warm.
         with self._lock:
-            if self._stacked_dirty or self._stacked_forest is None:
-                forests = [t._forest for t in self._tenants.values()]
-                self._stacked_forest = jax.tree_util.tree_map(  # audit: ok[DAL202]
+            if group.dirty or group.stacked is None:
+                forests = [self._tenants[tid]._forest for tid in group.tids]
+                group.stacked = jax.tree_util.tree_map(  # audit: ok[DAL202]
                     lambda *ls: jnp.stack(ls), *forests
                 )
-                self._stacked_dirty = False
-            return self._stacked_forest
+                group.dirty = False
+            return group.stacked
 
     def score_many(self, requests: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
         """Serve concurrent score requests from several tenants as fused
-        cross-tenant launches (ONE program execution per width-round).
+        cross-tenant launches (ONE program execution per group per
+        width-round).
 
-        The tenant axis spans EVERY resident tenant (absent ones ride as
-        zero-valid padding — the aval-stability discipline), so the program
-        compiles once per resident-set size. Requests wider than
-        ``score_width`` are served in width-rounds: each round launches one
-        batch holding every tenant's next sub-block. Falls back to the
-        per-tenant endpoint (same results, N launches) with a named reason
-        when forests cannot stack.
+        Resident tenants are partitioned into same-signature GROUPS
+        (:meth:`_score_grouping`); each group keeps a resident stacked
+        forest and its own stacked score program, and its tenant axis spans
+        every member (absent ones ride as zero-valid padding — the
+        aval-stability discipline), so each program compiles once per group
+        membership. Requests wider than ``score_width`` are served in
+        width-rounds: each round launches one batch holding every group
+        member's next sub-block. Only tenants the partition could NOT group
+        (singleton signature, unbatchable kernel, single resident tenant)
+        fall back to the per-tenant endpoint, each with a named reason.
         """
         order = [tid for tid in self._tenants if tid in requests]
         unknown = set(requests) - set(order)
@@ -1553,29 +1641,7 @@ class TenantManager:
             raise KeyError(f"unknown tenants in score_many: {sorted(unknown)}")
         if not order:
             return {}
-        reason = self._batched_score_reason()
-        if reason is not None:
-            self.score_fallback_reasons[reason] = (
-                self.score_fallback_reasons.get(reason, 0) + 1
-            )
-            out: Dict[str, np.ndarray] = {}
-            for i, tid in enumerate(order):
-                try:
-                    out[tid] = self._tenants[tid].score(requests[tid])
-                except Exception as e:
-                    # Availability accounting, completion-aware: the failing
-                    # tenant and every tenant NOT yet served count a failed
-                    # query; tenants already served keep their (real) good
-                    # observations — charging everyone would double-count
-                    # requests that completed (frontend callers still see
-                    # the whole call fail; SLO counts what actually ran).
-                    for rem in order[i:]:
-                        self._tenants[rem].note_query_failure(e)
-                    raise
-            return out
-        tenants_all = list(self._tenants.values())
-        width = tenants_all[0].serve.score_width
-        d = int(tenants_all[0]._slab.x.shape[1])
+        groups, fallback_by_tid = self._score_grouping()
         arrays: Dict[str, np.ndarray] = {}
         for tid in order:
             q = np.asarray(requests[tid], np.float32)
@@ -1584,51 +1650,84 @@ class TenantManager:
             arrays[tid] = q
         outs: Dict[str, list] = {tid: [] for tid in order}
         pos = {tid: 0 for tid in order}
-        while any(pos[tid] < arrays[tid].shape[0] for tid in order):
-            self.poll()  # once per distinct in-flight launch per width-round
-            qpad = np.zeros((len(tenants_all), width, d), np.float32)
-            n_valid = [0] * len(tenants_all)
-            round_tids = set()
-            for i, t in enumerate(tenants_all):
-                tid = t.tenant_id
-                if tid not in arrays or pos[tid] >= arrays[tid].shape[0]:
-                    continue
-                block = arrays[tid][pos[tid] : pos[tid] + width]
-                pos[tid] += block.shape[0]
-                qpad[i, : block.shape[0]] = block
-                n_valid[i] = block.shape[0]
-                round_tids.add(tid)
-            try:
-                t0 = time.perf_counter()
-                scores, ents = self._batched_score_fn(
-                    self._stacked(), jnp.asarray(qpad)
+
+        def charge_failure(e: Exception, attempted) -> None:
+            # Availability accounting, completion-aware (SLO observations
+            # are per width-round/block): the tenants in the failed attempt
+            # plus every tenant with blocks never attempted count one
+            # failure each; blocks that already completed keep their (real)
+            # good observations — charging everyone would double-count
+            # requests that completed (frontend callers still see the whole
+            # call fail; SLO counts what actually ran).
+            for tid in order:
+                if tid in attempted or pos[tid] < arrays[tid].shape[0]:
+                    self._tenants[tid].note_query_failure(e)
+
+        # One vmapped launch per GROUP per width-round: the group axis spans
+        # every member (absent ones ride as zero-valid padding — the
+        # aval-stability discipline), so each group's program compiles once
+        # per membership.
+        for group in groups.values():
+            in_play = [tid for tid in group.tids if tid in arrays]
+            if not in_play:
+                continue
+            width, d = group.width, group.features
+            while any(pos[tid] < arrays[tid].shape[0] for tid in in_play):
+                self.poll()  # once per distinct in-flight launch per round
+                qpad = np.zeros((len(group.tids), width, d), np.float32)
+                n_valid = [0] * len(group.tids)
+                round_tids = set()
+                for i, tid in enumerate(group.tids):
+                    if tid not in arrays or pos[tid] >= arrays[tid].shape[0]:
+                        continue
+                    block = arrays[tid][pos[tid] : pos[tid] + width]
+                    pos[tid] += block.shape[0]
+                    qpad[i, : block.shape[0]] = block
+                    n_valid[i] = block.shape[0]
+                    round_tids.add(tid)
+                try:
+                    t0 = time.perf_counter()
+                    scores, ents = group.fn(
+                        self._stacked_for(group), jnp.asarray(qpad)
+                    )
+                    scores_np = np.asarray(scores)  # the blocking fetch = latency
+                    dt = time.perf_counter() - t0
+                    ents_np = np.asarray(ents)
+                except Exception as e:
+                    charge_failure(e, round_tids)
+                    raise
+                group.tracker.record(
+                    dt, tenants=sum(1 for n in n_valid if n),
+                    group_size=len(group.tids),
                 )
-                scores_np = np.asarray(scores)  # the one blocking fetch = latency
-                dt = time.perf_counter() - t0
-                ents_np = np.asarray(ents)
-            except Exception as e:
-                # Block-granular availability accounting (SLO observations
-                # are per width-round): the blocks in the failed launch plus
-                # every block never attempted count one failure per tenant;
-                # width-rounds that already completed keep their good
-                # observations.
-                for tid in order:
-                    if tid in round_tids or pos[tid] < arrays[tid].shape[0]:
-                        self._tenants[tid].note_query_failure(e)
-                raise
-            self._batched_score_tracker.record(
-                dt, tenants=sum(1 for n in n_valid if n)
+                group.launches += 1
+                self.batched_score_launches += 1
+                for i, tid in enumerate(group.tids):
+                    n = n_valid[i]
+                    if not n:
+                        continue
+                    outs[tid].append(scores_np[i, :n])
+                    self._tenants[tid]._finish_query(
+                        dt, n, float(np.mean(ents_np[i, :n])), batched=True
+                    )
+                self._maybe_refit_group()
+        # Per-tenant fallback for everyone the partition could not group —
+        # with a NAMED reason (singleton_signature / kernel / single_tenant),
+        # never silent. A tenant sharing its signature with at least one
+        # other resident never lands here.
+        for tid in order:
+            reason = fallback_by_tid.get(tid)
+            if reason is None:
+                continue
+            self.score_fallback_reasons[reason] = (
+                self.score_fallback_reasons.get(reason, 0) + 1
             )
-            self.batched_score_launches += 1
-            for i, t in enumerate(tenants_all):
-                n = n_valid[i]
-                if not n:
-                    continue
-                outs[t.tenant_id].append(scores_np[i, :n])
-                t._finish_query(
-                    dt, n, float(np.mean(ents_np[i, :n])), batched=True
-                )
-            self._maybe_refit_group()
+            try:
+                outs[tid].append(self._tenants[tid].score(arrays[tid]))
+                pos[tid] = arrays[tid].shape[0]
+            except Exception as e:
+                charge_failure(e, {tid})
+                raise
         return {
             tid: (
                 np.concatenate(outs[tid]) if len(outs[tid]) > 1
@@ -1893,7 +1992,9 @@ class TenantManager:
             t.cause_counts.clear()
 
     def recompiles_after_warmup(self) -> int:
-        total = self._batched_score_tracker.recompiles
+        total = self._retired_group_recompiles
+        for g in (self._score_groups or {}).values():
+            total += g.tracker.recompiles
         for _, tracker in self._batched_chunks.values():
             total += tracker.recompiles
         for t in self._tenants.values():
@@ -1943,6 +2044,7 @@ class TenantManager:
             "batched_score_launches": self.batched_score_launches,
             "batched_refit_launches": self.batched_refit_launches,
             "score_fallback_reasons": dict(self.score_fallback_reasons),
+            "score_groups": self.score_groups(),
             "precompiles": self.precompiles,
             "precompile_errors": self.precompile_errors,
             "post_warmup_growth_compile_events":

@@ -1,0 +1,446 @@
+"""Fleet serving: the consistent-hash router, grouped stacked scoring,
+burn-rate admission, and rebalance hysteresis.
+
+The load-bearing guarantees pinned here:
+
+- **The hash ring is consistent** — adding a node to an N-node ring remaps
+  roughly 1/(N+1) of the keys (all of them TO the new node), removing it
+  restores the original mapping exactly, and the failover walk leads with
+  the owner.
+- **The router routes around a sick worker** — a worker whose ``/healthz``
+  answers 503 is skipped on the forwarding walk (its tenants land on the
+  next healthy worker, counted as rerouted) while the fleet's own
+  ``/healthz`` stays 200, and the binary wire form round-trips through the
+  router byte-exactly without the router parsing the payload.
+- **Grouped stacked scoring is bit-identical to independent services** — a
+  mixed-signature manager (two tenants sharing a forest signature plus a
+  structurally-alone one) produces EXACTLY the scores of N independent
+  single-tenant ALServices; the shared-signature tenants never fall back
+  (the fleet acceptance criterion) and the singleton's fallback carries the
+  named ``singleton_signature`` reason, never silence.
+- **Burn-rate admission acts on the PR-15 gauges** — a tenant whose 5m burn
+  crosses ``burn_shed_threshold`` has new SCORE work shed at admission
+  (ingest never), and a burning tenant is deprioritized in the dispatch
+  WRR.
+- **RebalanceHysteresis is thrash-proof** — enter/exit band plus the
+  min-interval rate limit fire far fewer epochs than the bare trigger under
+  an adversarial oscillation, without ignoring genuine skew.
+- **The fleet summary table skips malformed events** — torn JSONL tails
+  from long-running fleets degrade to fewer rows, never a crash.
+"""
+
+import json
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from distributed_active_learning_tpu.config import (
+    ExperimentConfig,
+    ForestConfig,
+    ServeConfig,
+    StrategyConfig,
+)
+from distributed_active_learning_tpu.serving.fleet import HashRing, RouterServer
+from distributed_active_learning_tpu.serving.frontend import (
+    AdmissionError,
+    ServiceFrontend,
+)
+from distributed_active_learning_tpu.serving.service import ALService
+from distributed_active_learning_tpu.serving.slab import (
+    RebalanceHysteresis,
+    rebalance_trigger,
+)
+from distributed_active_learning_tpu.serving.tenants import TenantManager
+
+
+# ---------------------------------------------------------------------------
+# HashRing
+# ---------------------------------------------------------------------------
+
+
+def test_hash_ring_remap_fraction_and_stability():
+    keys = [f"tenant-{i}" for i in range(2000)]
+    ring = HashRing([f"w{i}" for i in range(4)])
+    before = {k: ring.lookup(k) for k in keys}
+    ring.add("w4")
+    after = {k: ring.lookup(k) for k in keys}
+    moved = [k for k in keys if before[k] != after[k]]
+    # expected 1/5 = 0.2; vnodes=64 smoothing keeps the spread tight, but
+    # leave honest slack for the hash's arc-length variance
+    assert 0.10 < len(moved) / len(keys) < 0.35
+    # consistency: every moved key moved TO the new node — no churn between
+    # surviving nodes
+    assert all(after[k] == "w4" for k in moved)
+    ring.remove("w4")
+    assert {k: ring.lookup(k) for k in keys} == before
+
+
+def test_hash_ring_failover_walk_owner_first():
+    ring = HashRing(["w0", "w1", "w2"])
+    for key in ("u0", "u1", "abc"):
+        walk = ring.nodes_for(key)
+        assert walk[0] == ring.lookup(key)
+        assert sorted(walk) == ["w0", "w1", "w2"]  # all distinct, all nodes
+    assert HashRing([]).lookup("u0") is None
+    assert HashRing(["solo"]).nodes_for("u0", n=5) == ["solo"]
+
+
+# ---------------------------------------------------------------------------
+# RouterServer against stub HTTP workers (no JAX, no subprocesses)
+# ---------------------------------------------------------------------------
+
+
+def _stub_server(handler_cls):
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), handler_cls)
+    httpd.daemon_threads = True
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    return httpd, int(httpd.server_address[1])
+
+
+def _stub_worker(healthy: bool):
+    """One fake worker: an echo /score endpoint and an ops plane whose
+    /healthz verdict is fixed — the router only ever sees HTTP."""
+
+    class _Score(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *_a):
+            pass
+
+        def do_POST(self):  # noqa: N802
+            n = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(n)
+            ctype = self.headers.get("Content-Type", "application/json")
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)  # echo: forwarding is byte-transparent
+
+    class _Ops(BaseHTTPRequestHandler):
+        def log_message(self, *_a):
+            pass
+
+        def do_GET(self):  # noqa: N802
+            code = 200 if healthy else 503
+            body = json.dumps({"ok": healthy}).encode()
+            self.send_response(code)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    s1, score_port = _stub_server(_Score)
+    s2, ops_port = _stub_server(_Ops)
+    return (s1, s2), {"host": "127.0.0.1", "score_port": score_port,
+                      "ops_port": ops_port}
+
+
+def test_router_routes_around_unhealthy_worker():
+    (a1, a2), ep_ok = _stub_worker(healthy=True)
+    (b1, b2), ep_sick = _stub_worker(healthy=False)
+    router = RouterServer(
+        {"wok": ep_ok, "wsick": ep_sick}, port=0, health_ttl=0.05
+    ).start()
+    try:
+        base = f"http://127.0.0.1:{router.port}"
+        # tenants owned by EACH worker, so the walk is exercised both ways
+        tids = [f"u{i}" for i in range(16)]
+        owned_by_sick = [t for t in tids if router.ring.lookup(t) == "wsick"]
+        assert owned_by_sick, "want at least one tenant owned by the sick worker"
+        for tid in tids:
+            body = json.dumps({"tenant": tid, "queries": [[1.0, 2.0]]}).encode()
+            req = urllib.request.Request(
+                base + "/score", data=body,
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=10) as r:
+                assert r.status == 200
+                assert json.loads(r.read())["tenant"] == tid  # echo survived
+        summary = router.summary()
+        assert summary["routed"].get("wsick", 0) == 0  # never forwarded there
+        assert summary["routed"]["wok"] == len(tids)
+        assert summary["rerouted"] == len(owned_by_sick)
+        assert summary["unhealthy_skips"] >= len(owned_by_sick)
+        assert summary["unroutable"] == 0
+        # the FLEET is up while anyone can serve: router /healthz stays 200
+        with urllib.request.urlopen(base + "/healthz", timeout=10) as r:
+            verdict = json.loads(r.read())
+            assert r.status == 200 and verdict["ok"]
+            assert verdict["workers"] == {"wok": True, "wsick": False}
+        # binary wire form: ?tenant= routes it, the payload passes through
+        # byte-exactly (the router never parses octet-stream bodies)
+        blob = b"\x02\x00\x00\x00\x03\x00\x00\x00" + np.arange(
+            6, dtype=np.float32
+        ).tobytes()
+        req = urllib.request.Request(
+            base + "/score?tenant=u0", data=blob,
+            headers={"Content-Type": "application/octet-stream"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.status == 200
+            assert r.read() == blob
+    finally:
+        router.stop()
+        for s in (a1, a2, b1, b2):
+            s.shutdown()
+            s.server_close()
+
+
+def test_router_503_when_no_healthy_worker():
+    (b1, b2), ep_sick = _stub_worker(healthy=False)
+    router = RouterServer({"wsick": ep_sick}, port=0, health_ttl=0.05).start()
+    try:
+        body = json.dumps({"tenant": "u0", "queries": [[1.0]]}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{router.port}/score", data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req, timeout=10)
+        assert exc.value.code == 503
+        assert router.summary()["unroutable"] == 1
+    finally:
+        router.stop()
+        b1.shutdown()
+        b1.server_close()
+        b2.shutdown()
+        b2.server_close()
+
+
+# ---------------------------------------------------------------------------
+# Grouped stacked scoring: bit-identity on a mixed-signature manager
+# ---------------------------------------------------------------------------
+
+
+def _points(n, d=4, seed=0, shift=0.0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d)).astype(np.float32) + shift
+    y = (x[:, 0] + 0.3 * x[:, 1] > shift).astype(np.int32)
+    return x, y
+
+
+def _mixed_cfg(i, n_trees):
+    cfg = ExperimentConfig(
+        forest=ForestConfig(
+            n_trees=n_trees, max_depth=3, max_bins=8, fit="device",
+            fit_budget=64,
+        ),
+        strategy=StrategyConfig(name="uncertainty", window_size=4),
+        n_start=6,
+        log_every=0,
+        seed=i,
+    )
+    serve = ServeConfig(
+        slab_rows=64,
+        ingest_block=16,
+        score_width=16,
+        refit_rounds=2,
+        max_staleness=0,
+        drift_entropy_shift=99.0,
+        precompile_ahead=False,
+    )
+    return cfg, serve
+
+
+@pytest.fixture(scope="module")
+def mixed_sig_manager():
+    """Two tenants sharing a forest signature (6 trees) plus one whose
+    signature is structurally alone (8 trees) — the exact partition the
+    fleet's per-worker managers run — and three independent single-tenant
+    services fed identical traffic."""
+    trees = {"g0": 6, "g1": 6, "alone": 8}
+    mgr = TenantManager()
+    svcs = {}
+    for i, (tid, n_trees) in enumerate(trees.items()):
+        cfg, serve = _mixed_cfg(i, n_trees)
+        x0, y0 = _points(40, seed=10 + i, shift=0.3 * i)
+        tx, ty = _points(24, seed=50 + i, shift=0.3 * i)
+        mgr.add_tenant(tid, cfg, serve, x0, y0, tx, ty)
+        svcs[tid] = ALService(cfg, serve, x0, y0, tx, ty)
+    yield mgr, svcs
+    mgr.close()
+
+
+def test_grouped_scoring_bit_identical_and_fallbacks_named(mixed_sig_manager):
+    mgr, svcs = mixed_sig_manager
+    queries = {
+        tid: _points(10, seed=90 + i)[0]
+        for i, tid in enumerate(("g0", "g1", "alone"))
+    }
+    batched = mgr.score_many(queries)
+    for tid, q in queries.items():
+        np.testing.assert_array_equal(batched[tid], svcs[tid].score(q))
+    # the partition: one same-signature group for the 6-tree pair; the
+    # 8-tree tenant rides the per-tenant path with a NAMED reason
+    assert mgr.score_groups() == [["g0", "g1"]]
+    assert mgr.score_fallback_reasons == {"singleton_signature": 1}
+    assert mgr.batched_score_launches >= 1
+
+
+def test_grouped_scoring_restacks_after_refit(mixed_sig_manager):
+    """A re-fit dirties the resident stack; the next fused launch serves the
+    REFRESHED forests — still bit-identical to the single services."""
+    mgr, svcs = mixed_sig_manager
+    for i, tid in enumerate(("g0", "g1", "alone")):
+        sx, sy = _points(16, seed=70 + i, shift=0.3 * i)
+        mgr.submit(tid, sx, sy)
+        svcs[tid].submit(sx, sy)
+    assert mgr.refit_now("test") == 3
+    for s in svcs.values():
+        assert s.refit_now("test")
+    mgr.flush()
+    for s in svcs.values():
+        s.flush()
+    queries = {
+        tid: _points(8, seed=120 + i)[0]
+        for i, tid in enumerate(("g0", "g1", "alone"))
+    }
+    post = mgr.score_many(queries)
+    for tid, q in queries.items():
+        np.testing.assert_array_equal(post[tid], svcs[tid].score(q))
+    # the shared-signature pair NEVER fell back — only the singleton's
+    # counter advanced (one per score_many cycle)
+    assert set(mgr.score_fallback_reasons) == {"singleton_signature"}
+
+
+# ---------------------------------------------------------------------------
+# Burn-rate admission (the first consumer that ACTS on the PR-15 gauges)
+# ---------------------------------------------------------------------------
+
+
+def test_burn_admission_sheds_scores_never_ingest(mixed_sig_manager):
+    mgr, _ = mixed_sig_manager
+    import dataclasses
+
+    from distributed_active_learning_tpu.runtime import obs
+
+    t = mgr.tenant("g0")
+    old_slo, old_serve = t.slo, t.serve
+    t.slo = obs.SLOTracker(objective_seconds=0.001, target=0.9)
+    t.serve = dataclasses.replace(old_serve, burn_shed_threshold=2.0)
+    fe = ServiceFrontend(mgr)
+    try:
+        # burn the 5m window: every query failed -> burn = 1/(1-0.9) = 10
+        for _ in range(8):
+            t.slo.observe(None, ok=False)
+        with pytest.raises(AdmissionError, match="burn"):
+            fe.submit_score("g0", _points(4, seed=1)[0])
+        assert fe.burn_shed == {"g0": 1}
+        assert obs.counter("admission_burn_sheds", tenant="g0").value >= 1
+        # ingest is NEVER shed: fresh data is how a burning tenant recovers
+        fe._running = True  # enqueue-only: the dispatcher is not started
+        bx, by = _points(4, seed=2)
+        fut = fe.submit_ingest("g0", bx, by)
+        assert not fut.done()
+        # and the dispatch WRR deprioritizes the burning tenant
+        assert fe._credit_ok("g0") in (True, False)
+        assert fe.burn_deprioritized.get("g0", 0) >= 1
+    finally:
+        fe._running = False
+        t.slo, t.serve = old_slo, old_serve
+
+
+# ---------------------------------------------------------------------------
+# RebalanceHysteresis
+# ---------------------------------------------------------------------------
+
+
+def test_hysteresis_band_inverted_refused():
+    with pytest.raises(ValueError, match="band"):
+        RebalanceHysteresis(enter_ratio=1.5, exit_ratio=2.0)
+
+
+def test_hysteresis_enter_exit_band_and_interval():
+    h = RebalanceHysteresis(enter_ratio=2.0, exit_ratio=1.5, min_interval=3)
+    assert not h.update([5, 5])            # balanced: nothing
+    assert h.update([8, 2])                # first excursion fires immediately
+    assert h.active
+    assert not h.update([8, 2])            # interval gate holds
+    assert h.suppressed_interval == 1
+    # still ACTIVE inside the band (1.8 <= 2.0 but > exit 1.5): once the
+    # interval elapses the follow-up epoch fires — the skew is being worked
+    assert not h.update([9, 5])
+    assert h.update([9, 5])
+    assert h.fired == 2
+    assert not h.update([7, 5])            # 1.4 <= exit: the band closes
+    assert not h.active
+    # hovering at 1.8 AFTER recovery never re-fires (entered-from-above only)
+    assert not h.update([9, 5])
+    assert h.suppressed_band >= 1
+    assert not h.update([0, 0])            # empty pool: inert, inactive
+    assert not h.active
+
+
+def test_hysteresis_thrash_vs_bare_trigger():
+    """An oscillation straddling the threshold: the bare trigger fires every
+    other step forever; the hysteresis pays the interval-limited few."""
+    seq = [[9, 4], [7, 4]] * 20            # ratios 2.25 / 1.75, alternating
+    bare = sum(rebalance_trigger(f, ratio=2.0) for f in seq)
+    h = RebalanceHysteresis(enter_ratio=2.0, exit_ratio=1.5, min_interval=4)
+    fired = sum(h.update(f) for f in seq)
+    assert bare == 20
+    assert fired == h.fired <= bare // 2
+    assert h.suppressed_interval > 0
+
+
+# ---------------------------------------------------------------------------
+# The fleet summary table (benches/summarize_metrics.py)
+# ---------------------------------------------------------------------------
+
+
+def test_summarize_fleet_table_skips_malformed_events():
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "summarize_metrics",
+        os.path.join(
+            os.path.dirname(__file__), "..", "benches", "summarize_metrics.py"
+        ),
+    )
+    sm = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(sm)
+    events = [
+        {"kind": "fleet_worker", "worker": "w0", "workers": 2, "tenants": 4,
+         "qps": 81.25, "p99_ms": 6.1, "groups": 1, "fallbacks": 0},
+        {"kind": "fleet_worker", "worker": "w1", "workers": 2, "tenants": 4,
+         "qps": 79.9, "p99_ms": 5.8, "groups": 2, "fallbacks": 0},
+        {"kind": "fleet_worker", "worker": "w2", "qps": "oops"},   # non-numeric
+        {"kind": "fleet_worker", "qps": 10.0},                     # no worker
+        {"kind": "fleet_worker", "worker": "w3", "qps": True},     # bool qps
+    ]
+    out = sm.summarize(events)
+    assert "== fleet ==" in out
+    assert "2 workers" in out
+    assert "w0" in out and "81.25" in out and "6.100" in out
+    assert "w2" not in out and "w3" not in out
+    # no fleet events at all: the section is absent, not empty
+    assert "== fleet ==" not in sm.summarize([{"kind": "round"}])
+
+
+# ---------------------------------------------------------------------------
+# serve_group audit programs (analysis/programs.py)
+# ---------------------------------------------------------------------------
+
+
+def test_serve_group_audit_units_registered():
+    from distributed_active_learning_tpu.analysis import programs
+
+    assert "serve_group" in programs.KINDS
+    names = programs.serve_group_program_names()
+    assert names == ["stacked_score_g2", "stacked_score_g3"]
+    units = programs.build_registry(
+        kinds=["serve_group"], placements=["cpu"]
+    )
+    assert [u.name for u in units] == [
+        f"serve_group/{n}/cpu" for n in names
+    ]
+    # the grouped path is the CPU-side serving core: a mesh-only filter must
+    # not smuggle its cpu programs back into the audit
+    assert not programs.build_registry(
+        kinds=["serve_group"], placements=["mesh4x2"]
+    )
